@@ -1,0 +1,57 @@
+"""flink_parameter_server_tpu — a TPU-native parameter-server framework.
+
+A from-scratch re-founding of FlinkML/flink-parameter-server (Scala/Flink)
+on JAX/XLA for TPU: the ``transform(data, worker_logic, server_logic)``
+abstraction with ``pull(id)`` / ``push(id, delta)`` worker hooks, where the
+server-side keyed store is a pjit-sharded HBM array and pull/push compile to
+sharded gather / scatter-add over ICI collectives inside one jitted step.
+
+See SURVEY.md at the repo root for the reference structural analysis this
+build follows, and README.md for the architecture overview.
+"""
+
+from .core.api import (
+    ParameterServer,
+    ParameterServerClient,
+    ParameterServerLogic,
+    SimplePSLogic,
+    WorkerLogic,
+    add_pull_limiter,
+)
+from .core.batched import BatchedWorkerLogic, PushRequest
+from .core.entities import Pull, PullAnswer, Push, PSToWorker, WorkerToPS
+from .core.store import ShardedParamStore, StoreSpec
+from .core.transform import (
+    TransformResult,
+    transform,
+    transform_batched,
+    transform_with_model_load,
+)
+from .parallel.mesh import DP_AXIS, PS_AXIS, make_mesh
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ParameterServer",
+    "ParameterServerClient",
+    "ParameterServerLogic",
+    "SimplePSLogic",
+    "WorkerLogic",
+    "add_pull_limiter",
+    "BatchedWorkerLogic",
+    "PushRequest",
+    "Pull",
+    "Push",
+    "PullAnswer",
+    "WorkerToPS",
+    "PSToWorker",
+    "ShardedParamStore",
+    "StoreSpec",
+    "TransformResult",
+    "transform",
+    "transform_batched",
+    "transform_with_model_load",
+    "make_mesh",
+    "DP_AXIS",
+    "PS_AXIS",
+]

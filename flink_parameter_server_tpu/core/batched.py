@@ -1,0 +1,68 @@
+"""Batched (jit-compiled) worker API — the TPU programming model.
+
+Reference parity: this is the compiled counterpart of the reference's
+``WorkerLogic`` trait (SURVEY.md §2 #2).  Where the reference invokes
+``onRecv`` per record and ``onPullRecv`` per answer on a JVM thread, the TPU
+rebuild processes a *microbatch of events per jitted step*:
+
+    ids            = logic.keys(batch)                # which params to pull
+    pulled         = store.pull(ids)                  # sharded gather
+    state', req, o = logic.step(state, batch, pulled) # the "training math"
+    store'         = store.push(req.ids, req.deltas)  # sharded scatter-add
+
+The worker's mutable local state (e.g. MF user vectors) is an explicit
+pytree threaded through ``step`` — data-parallel across the ``dp`` mesh axis
+the way the reference's worker state is partitioned across
+``workerParallelism`` subtasks.
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Generic, Optional, Tuple, TypeVar
+
+import jax
+
+Array = jax.Array
+State = TypeVar("State")
+Batch = TypeVar("Batch")
+Out = TypeVar("Out")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PushRequest:
+    """A microbatch of pushes: fold ``deltas[i]`` into param ``ids[i]``.
+
+    ``mask`` marks valid lanes (padding-friendly static shapes)."""
+
+    ids: Array
+    deltas: Array
+    mask: Optional[Array] = None
+
+
+class BatchedWorkerLogic(abc.ABC, Generic[State, Batch, Out]):
+    """Pure-functional worker logic compiled into the jitted step."""
+
+    @abc.abstractmethod
+    def init_state(self, rng: Array) -> State:
+        """Create the worker-local state pytree (sharded along ``dp``)."""
+
+    @abc.abstractmethod
+    def keys(self, batch: Batch) -> Array:
+        """Param ids this microbatch needs pulled (static shape; pad +
+        mask for variable counts)."""
+
+    @abc.abstractmethod
+    def step(
+        self, state: State, batch: Batch, pulled: Array
+    ) -> Tuple[State, PushRequest, Out]:
+        """One compiled training step over the microbatch."""
+
+    def finish(self, state: State) -> Any:  # noqa: B027
+        """Optional close-time worker output (e.g. dump local user
+        vectors) — counterpart of ``WorkerLogic.close``."""
+        return None
+
+
+__all__ = ["PushRequest", "BatchedWorkerLogic"]

@@ -1,0 +1,77 @@
+"""Wire-level message entities of the parameter-server protocol.
+
+Reference parity: mirrors the message case classes of
+``hu.sztaki.ilab.ps.entities`` in FlinkML/flink-parameter-server
+(Pull, Push, PullAnswer, WorkerToPS, PSToWorker — SURVEY.md §2 #5).
+
+In the reference these are per-record stream payloads ferried between the
+worker and server CoFlatMap operators over Flink's Netty channels.  In the
+TPU rebuild the *hot path never materialises them*: a microbatch of pulls is
+a sharded gather and a microbatch of pushes a sharded scatter-add inside one
+jitted step.  The dataclasses below exist for
+
+  * the host-side event backend (``backend="local"``), which reproduces the
+    reference's per-record callback semantics exactly, and
+  * tracing/debug dumps, where reconstructing the logical message stream
+    from a batched step is useful.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, TypeVar, Union
+
+P = TypeVar("P")  # parameter value type
+
+
+@dataclass(frozen=True)
+class Pull:
+    """Worker asks the server for the current value of ``param_id``."""
+
+    param_id: int
+
+
+@dataclass(frozen=True)
+class Push(Generic[P]):
+    """Worker sends a delta for ``param_id`` to be folded into the store."""
+
+    param_id: int
+    delta: Any
+
+
+@dataclass(frozen=True)
+class PullAnswer(Generic[P]):
+    """Server's reply to a :class:`Pull`."""
+
+    param_id: int
+    value: Any
+
+
+@dataclass(frozen=True)
+class WorkerToPS(Generic[P]):
+    """Envelope on the worker→server stream.
+
+    ``worker_partition_index`` is embedded so the server can address the
+    answer back to the right worker subtask — the reference carries it in
+    every message for the same reason (SURVEY.md §2 "Distributed
+    communication backend").
+    """
+
+    worker_partition_index: int
+    message: Union[Pull, Push]
+
+
+@dataclass(frozen=True)
+class PSToWorker(Generic[P]):
+    """Envelope on the server→worker (feedback) stream."""
+
+    worker_partition_index: int
+    answer: PullAnswer
+
+
+__all__ = [
+    "Pull",
+    "Push",
+    "PullAnswer",
+    "WorkerToPS",
+    "PSToWorker",
+]

@@ -1,0 +1,269 @@
+"""ShardedParamStore — the TPU-native server-side keyed parameter store.
+
+Reference parity: replaces the reference server's per-subtask
+``HashMap[Int, P]`` with ``getOrElseUpdate(id, init(id))`` semantics
+(``SimplePSLogic`` — SURVEY.md §2 #3) and its ``hash(paramId) % psParallelism``
+routing (SURVEY.md §2 "Model parallelism").
+
+TPU-first design
+----------------
+The store is a dense ``(capacity, *value_shape)`` ``jax.Array`` living in HBM,
+row-sharded over a named mesh axis (``"ps"``).  The reference's message-level
+protocol maps onto array ops *inside* a jitted step:
+
+  * ``pull(ids)``  → sharded gather (``jnp.take``); XLA lowers the
+    cross-shard reads to ICI collectives (or we do it explicitly with
+    ``shard_map`` — see :mod:`..parallel.collectives`).
+  * ``push(ids, deltas)`` → sharded scatter-add (``table.at[ids].add``).
+
+"Lazy init on first pull" in the reference uses a *deterministic per-id*
+initializer (``RangedRandomFactorInitializerDescriptor``), so eager
+whole-table initialisation at create time is observationally equivalent and
+far more TPU-friendly (one fused init kernel instead of per-row branches).
+
+Duplicate ids within one microbatch: the reference applies each push
+sequentially; with the default commutative ``add`` update, combining
+duplicates with a segment-sum is exactly equivalent.  For *non-commutative*
+custom ``update`` functions, intra-batch duplicate deltas are summed first
+and ``update`` is then applied once per touched id — the documented
+semantic delta vs. the reference (bounded staleness ≤ one microbatch;
+SURVEY.md §7 "Guiding translation").
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+InitFn = Callable[[Array], Array]  # ids (n,) int32 -> values (n, *value_shape)
+UpdateFn = Callable[[Array, Array], Array]  # (current, combined_delta) -> new
+
+
+@dataclasses.dataclass(frozen=True)
+class StoreSpec:
+    """Static configuration of a parameter store (not a pytree leaf)."""
+
+    capacity: int
+    value_shape: Tuple[int, ...] = ()
+    dtype: Any = jnp.float32
+    # "add" uses the fast scatter-add path; any other callable takes the
+    # generic dense-update path (see module docstring; intra-batch
+    # duplicate deltas are always summed before `update` is applied).
+    update: Union[str, UpdateFn] = "add"
+    mesh: Optional[Mesh] = None
+    ps_axis: str = "ps"
+
+    @property
+    def num_shards(self) -> int:
+        if self.mesh is None:
+            return 1
+        return self.mesh.shape[self.ps_axis]
+
+    @property
+    def padded_capacity(self) -> int:
+        n = self.num_shards
+        return ((self.capacity + n - 1) // n) * n
+
+    def sharding(self) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(
+            self.mesh, P(self.ps_axis, *([None] * len(self.value_shape)))
+        )
+
+
+def zeros_init(spec: StoreSpec) -> InitFn:
+    def init(ids: Array) -> Array:
+        return jnp.zeros(ids.shape + spec.value_shape, spec.dtype)
+
+    return init
+
+
+def create_table(spec: StoreSpec, init_fn: Optional[InitFn] = None) -> Array:
+    """Materialise the full table, eagerly initialised via ``init_fn``.
+
+    ``init_fn`` must be deterministic per id (vectorised over an id array) —
+    the analogue of the reference's ranged-random factor initializer
+    descriptors, which exist precisely so that init is reproducible per key.
+    """
+    init_fn = init_fn or zeros_init(spec)
+    ids = jnp.arange(spec.padded_capacity, dtype=jnp.int32)
+    out_sharding = spec.sharding()
+
+    def build(ids):
+        return init_fn(ids)
+
+    if out_sharding is not None:
+        build = jax.jit(build, out_shardings=out_sharding)
+    else:
+        build = jax.jit(build)
+    return build(ids)
+
+
+def pull(spec: StoreSpec, table: Array, ids: Array) -> Array:
+    """Batched pull: ``values[i] = table[ids[i]]`` (sharded gather).
+
+    Out-of-range ids are clipped (callers use a validity mask alongside)."""
+    ids = jnp.clip(ids.astype(jnp.int32), 0, spec.padded_capacity - 1)
+    return jnp.take(table, ids, axis=0)
+
+
+def push(
+    spec: StoreSpec,
+    table: Array,
+    ids: Array,
+    deltas: Array,
+    mask: Optional[Array] = None,
+) -> Array:
+    """Batched push: fold ``deltas`` into rows ``ids`` (sharded scatter).
+
+    ``mask`` (same leading shape as ``ids``) zeroes out padding lanes — the
+    jit-friendly replacement for the reference's variable-length message
+    batches (SURVEY.md §7 "Dynamic shapes").  Out-of-range ids are dropped
+    (``mode="drop"``), matching :func:`..parallel.collectives.shard_push_add`.
+    """
+    ids = ids.astype(jnp.int32)
+    flat_ids = ids.reshape(-1)
+    # Negative ids would wrap (numpy semantics) before mode="drop" applies;
+    # route them to an always-out-of-bounds sentinel so they drop too.
+    flat_ids = jnp.where(flat_ids < 0, spec.padded_capacity, flat_ids)
+    flat_deltas = deltas.reshape((-1,) + spec.value_shape)
+    if mask is not None:
+        flat_mask = mask.reshape(-1)
+        # Masked-out lanes keep their id but carry a zero delta: for the
+        # fast add path zero deltas are a no-op; for the generic path the
+        # count is also masked.
+        flat_deltas = jnp.where(
+            flat_mask.reshape((-1,) + (1,) * len(spec.value_shape)),
+            flat_deltas,
+            jnp.zeros_like(flat_deltas),
+        )
+
+    if spec.update == "add":
+        return table.at[flat_ids].add(
+            flat_deltas.astype(table.dtype), mode="drop"
+        )
+
+    # Generic path: combine duplicates densely, then apply `update` once per
+    # touched row.  O(capacity) per step — documented slow path; the add
+    # fast path is the perf path.
+    combined = jnp.zeros_like(table).at[flat_ids].add(
+        flat_deltas.astype(table.dtype), mode="drop"
+    )
+    ones = jnp.ones(flat_ids.shape, jnp.int32)
+    if mask is not None:
+        ones = jnp.where(flat_mask, ones, 0)
+    counts = (
+        jnp.zeros((spec.padded_capacity,), jnp.int32)
+        .at[flat_ids]
+        .add(ones, mode="drop")
+    )
+    update_fn: UpdateFn = spec.update  # type: ignore[assignment]
+    updated = update_fn(table, combined)
+    touched = (counts > 0).reshape((-1,) + (1,) * len(spec.value_shape))
+    return jnp.where(touched, updated, table)
+
+
+@jax.tree_util.register_pytree_node_class
+class ShardedParamStore:
+    """Functional bundle of (spec, table).  All mutators return new stores.
+
+    The TPU-side equivalent of one *logical* parameter server spanning
+    ``spec.num_shards`` shards (the reference's ``psParallelism``).
+    """
+
+    def __init__(self, spec: StoreSpec, table: Array):
+        self.spec = spec
+        self.table = table
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def create(
+        cls,
+        capacity: int,
+        value_shape: Tuple[int, ...] = (),
+        *,
+        dtype: Any = jnp.float32,
+        init_fn: Optional[InitFn] = None,
+        update: Union[str, UpdateFn] = "add",
+        mesh: Optional[Mesh] = None,
+        ps_axis: str = "ps",
+    ) -> "ShardedParamStore":
+        spec = StoreSpec(
+            capacity=capacity,
+            value_shape=tuple(value_shape),
+            dtype=dtype,
+            update=update,
+            mesh=mesh,
+            ps_axis=ps_axis,
+        )
+        return cls(spec, create_table(spec, init_fn))
+
+    @classmethod
+    def from_values(
+        cls,
+        values: Array,
+        *,
+        update: Union[str, UpdateFn] = "add",
+        mesh: Optional[Mesh] = None,
+        ps_axis: str = "ps",
+    ) -> "ShardedParamStore":
+        """Seed the store from an existing ``(capacity, *value_shape)``
+        array — the reference's ``transformWithModelLoad`` analogue
+        (SURVEY.md §5 "Checkpoint / resume")."""
+        spec = StoreSpec(
+            capacity=values.shape[0],
+            value_shape=tuple(values.shape[1:]),
+            dtype=values.dtype,
+            update=update,
+            mesh=mesh,
+            ps_axis=ps_axis,
+        )
+        pad = spec.padded_capacity - spec.capacity
+        if pad:
+            values = jnp.concatenate(
+                [values, jnp.zeros((pad,) + spec.value_shape, spec.dtype)]
+            )
+        sharding = spec.sharding()
+        if sharding is not None:
+            values = jax.device_put(values, sharding)
+        return cls(spec, values)
+
+    # -- protocol ---------------------------------------------------------
+    def pull(self, ids: Array) -> Array:
+        return pull(self.spec, self.table, ids)
+
+    def push(
+        self, ids: Array, deltas: Array, mask: Optional[Array] = None
+    ) -> "ShardedParamStore":
+        return ShardedParamStore(
+            self.spec, push(self.spec, self.table, ids, deltas, mask)
+        )
+
+    def values(self) -> Array:
+        """Final model dump (unpadded) — the reference's close()-time
+        parameter flush (SURVEY.md §3.5)."""
+        return self.table[: self.spec.capacity]
+
+    # -- pytree plumbing ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.table,), self.spec
+
+    @classmethod
+    def tree_unflatten(cls, spec, leaves):
+        return cls(spec, leaves[0])
+
+
+__all__ = [
+    "StoreSpec",
+    "ShardedParamStore",
+    "create_table",
+    "pull",
+    "push",
+    "zeros_init",
+]

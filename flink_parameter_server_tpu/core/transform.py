@@ -1,0 +1,423 @@
+"""``transform`` — the framework entry point.
+
+Reference parity: re-founds ``FlinkParameterServer.transform`` and its
+overload family (SURVEY.md §2 #1, §3.1): wire a training stream + worker
+logic + server logic together, return the multiplexed worker/server output
+streams.  The reference's Flink iteration (feedback edge, per-message Netty
+hops, ``iterationWaitTime`` silence-timeout shutdown) is replaced by:
+
+  * ``backend="tpu"`` (the point of this framework): a microbatch of events
+    per jitted step; pull = sharded gather, push = sharded scatter-add, all
+    collectives over ICI.  Termination is explicit: the input iterator ends,
+    the final parameter dump is emitted — no silence-timeout hack
+    (SURVEY.md §7 "Termination/close semantics").
+
+  * ``backend="local"``: a host-side event loop running the *exact*
+    reference callback API (``on_recv`` / ``on_pull_recv`` / ``answer_pull``)
+    with FIFO message queues between worker and server partitions — the
+    semantics-fidelity harness (races included when ``input_window`` > 1)
+    and the migration path for arbitrary Python logics.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+from typing import Any, Callable, Generic, Iterable, List, Optional, Tuple, TypeVar, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .api import (
+    ParameterServer,
+    ParameterServerClient,
+    ParameterServerLogic,
+    SimplePSLogic,
+    WorkerLogic,
+)
+from .batched import BatchedWorkerLogic, PushRequest
+from .entities import Pull, PullAnswer, Push, PSToWorker, WorkerToPS
+from .store import ShardedParamStore
+from ..parallel.mesh import DP_AXIS
+
+T = TypeVar("T")
+P_ = TypeVar("P_")
+WOut = TypeVar("WOut")
+PSOut = TypeVar("PSOut")
+
+
+@dataclasses.dataclass
+class TransformResult(Generic[WOut, PSOut]):
+    """The two multiplexed output streams of a PS job — the reference
+    returns them as one ``DataStream[Either[WOut, PSOut]]``; we keep them
+    separate and offer :meth:`either` for parity."""
+
+    worker_outputs: List[Any]
+    server_outputs: List[Any]
+    store: Optional[ShardedParamStore] = None
+    worker_state: Any = None
+
+    def either(self) -> List[Tuple[str, Any]]:
+        return [("left", w) for w in self.worker_outputs] + [
+            ("right", s) for s in self.server_outputs
+        ]
+
+
+# ---------------------------------------------------------------------------
+# Local (event) backend — reference-exact callback semantics on the host.
+# ---------------------------------------------------------------------------
+
+
+class _LocalClient(ParameterServerClient):
+    def __init__(self, runtime: "_LocalRuntime", worker_idx: int):
+        self._rt = runtime
+        self._widx = worker_idx
+
+    def pull(self, param_id: int) -> None:
+        self._rt.events.append(("w2ps", WorkerToPS(self._widx, Pull(param_id))))
+
+    def push(self, param_id: int, delta) -> None:
+        self._rt.events.append(("w2ps", WorkerToPS(self._widx, Push(param_id, delta))))
+
+    def output(self, w_out) -> None:
+        self._rt.worker_outputs.append(w_out)
+
+
+class _LocalPSIface(ParameterServer):
+    def __init__(self, runtime: "_LocalRuntime"):
+        self._rt = runtime
+
+    def answer_pull(self, param_id: int, value, worker_idx: int) -> None:
+        self._rt.events.append(
+            ("ps2w", PSToWorker(worker_idx, PullAnswer(param_id, value)))
+        )
+
+    def output(self, ps_out) -> None:
+        self._rt.server_outputs.append(ps_out)
+
+
+class _LocalRuntime:
+    """Single FIFO event loop emulating the Flink iteration.
+
+    Input records are admitted up to ``input_window`` ahead of message
+    processing, so pulls/pushes from different workers interleave — the
+    async-hazard surface of the reference (SURVEY.md §3.2) reproduced
+    deterministically.
+    """
+
+    def __init__(
+        self,
+        worker_logics: List[WorkerLogic],
+        ps_logics: List[ParameterServerLogic],
+        partitioner: Optional[Callable[[Any, int], int]],
+        input_window: int,
+    ):
+        self.workers = worker_logics
+        self.servers = ps_logics
+        self.partitioner = partitioner
+        self.input_window = max(1, input_window)
+        self.events: collections.deque = collections.deque()
+        self.worker_outputs: List[Any] = []
+        self.server_outputs: List[Any] = []
+        self.ps_iface = _LocalPSIface(self)
+        self.clients = [_LocalClient(self, i) for i in range(len(self.workers))]
+
+    def _route_server(self, param_id: int) -> int:
+        # The reference's partitionCustom(hash(paramId) % psParallelism).
+        return hash(param_id) % len(self.servers)
+
+    def run(self, data: Iterable) -> None:
+        it = iter(data)
+        rr = itertools.cycle(range(len(self.workers)))
+        exhausted = False
+        in_window = 0
+        while True:
+            # Admit inputs up to the window.
+            while not exhausted and in_window < self.input_window:
+                try:
+                    record = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                widx = (
+                    self.partitioner(record, len(self.workers))
+                    if self.partitioner
+                    else next(rr)
+                )
+                self.events.append(("input", widx, record))
+                in_window += 1
+            if not self.events:
+                if exhausted:
+                    break
+                continue
+            ev = self.events.popleft()
+            if ev[0] == "input":
+                _, widx, record = ev
+                in_window -= 1
+                self.workers[widx].on_recv(record, self.clients[widx])
+            elif ev[0] == "w2ps":
+                msg: WorkerToPS = ev[1]
+                sidx = self._route_server(msg.message.param_id)
+                if isinstance(msg.message, Pull):
+                    self.servers[sidx].on_pull_recv(
+                        msg.message.param_id, msg.worker_partition_index, self.ps_iface
+                    )
+                else:
+                    self.servers[sidx].on_push_recv(
+                        msg.message.param_id, msg.message.delta, self.ps_iface
+                    )
+            else:  # ps2w
+                msg2: PSToWorker = ev[1]
+                self.workers[msg2.worker_partition_index].on_pull_recv(
+                    msg2.answer.param_id,
+                    msg2.answer.value,
+                    self.clients[msg2.worker_partition_index],
+                )
+        # Drain: input exhausted and all in-flight messages delivered →
+        # fire close hooks (the reference's iterationWaitTime-timeout moment,
+        # made explicit).
+        for w in self.workers:
+            w.close()
+        for s in self.servers:
+            s.close(self.ps_iface)
+
+
+def _instances(factory_or_instance, n: int, what: str) -> List[Any]:
+    if callable(factory_or_instance) and not isinstance(
+        factory_or_instance, (WorkerLogic, ParameterServerLogic)
+    ):
+        return [factory_or_instance() for _ in range(n)]
+    if n != 1:
+        raise ValueError(
+            f"{what} parallelism {n} > 1 requires a zero-arg factory, got an "
+            f"instance (stateful logics cannot be shared across partitions)"
+        )
+    return [factory_or_instance]
+
+
+# ---------------------------------------------------------------------------
+# TPU (batched) backend — the compiled hot path.
+# ---------------------------------------------------------------------------
+
+
+def make_train_step(
+    logic: BatchedWorkerLogic,
+    spec,
+) -> Callable:
+    """Build the fused pull→compute→push step (to be jit-compiled).
+
+    One call = one microbatch of "events": the reference's per-message hot
+    loop (SURVEY.md §3.1) collapsed into gather → math → scatter-add with
+    zero host round-trips.
+    """
+    from . import store as store_mod
+
+    def step(table, state, batch):
+        ids = logic.keys(batch)
+        pulled = store_mod.pull(spec, table, ids)
+        state, req, out = logic.step(state, batch, pulled)
+        table = store_mod.push(spec, table, req.ids, req.deltas, req.mask)
+        return table, state, out
+
+    return step
+
+
+def transform_batched(
+    data: Iterable,
+    worker_logic: BatchedWorkerLogic,
+    store: ShardedParamStore,
+    *,
+    rng: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    dp_axis: str = DP_AXIS,
+    collect_outputs: bool = True,
+    dump_model: bool = True,
+    on_step: Optional[Callable[[int, Any], None]] = None,
+) -> TransformResult:
+    """Run the compiled PS loop over an iterable of microbatches."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    spec = store.spec
+    mesh = mesh or spec.mesh
+
+    step = jax.jit(make_train_step(worker_logic, spec), donate_argnums=(0, 1))
+    state = worker_logic.init_state(rng)
+
+    batch_sharding = None
+    if mesh is not None and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1:
+        batch_sharding = NamedSharding(mesh, PartitionSpec(dp_axis))
+
+    table = store.table
+    worker_outputs: List[Any] = []
+    step_idx = 0
+    for batch in data:
+        if batch_sharding is not None:
+            batch = jax.tree.map(
+                lambda x: jax.device_put(x, batch_sharding), batch
+            )
+        table, state, out = step(table, state, batch)
+        if on_step is not None:
+            on_step(step_idx, out)
+        if collect_outputs:
+            worker_outputs.append(out)
+        step_idx += 1
+
+    final_store = ShardedParamStore(spec, table)
+    server_outputs: List[Any] = []
+    if dump_model:
+        # close()-time model flush (reference §3.5): emit the final table.
+        server_outputs.append(
+            (np.arange(spec.capacity), np.asarray(final_store.values()))
+        )
+    finish = worker_logic.finish(state)
+    if finish is not None:
+        worker_outputs.append(finish)
+    return TransformResult(
+        worker_outputs=worker_outputs,
+        server_outputs=server_outputs,
+        store=final_store,
+        worker_state=state,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The public overload family.
+# ---------------------------------------------------------------------------
+
+
+def transform(
+    data: Iterable,
+    worker_logic: Union[WorkerLogic, Callable[[], WorkerLogic], BatchedWorkerLogic],
+    ps_logic: Union[
+        ParameterServerLogic,
+        Callable[[], ParameterServerLogic],
+        ShardedParamStore,
+        None,
+    ] = None,
+    *,
+    param_init: Optional[Callable[[int], Any]] = None,
+    param_update: Optional[Callable[[Any, Any], Any]] = None,
+    worker_parallelism: int = 1,
+    ps_parallelism: int = 1,
+    iteration_wait_time: Optional[float] = None,  # accepted for parity; unused
+    partitioner: Optional[Callable[[Any, int], int]] = None,
+    input_window: Optional[int] = None,
+    **batched_kwargs,
+) -> TransformResult:
+    """Wire ``data`` + worker logic + server logic into a PS job.
+
+    Overloads (mirroring ``FlinkParameterServer.transform``):
+
+    * ``transform(data, worker, param_init=f, param_update=g, ...)`` —
+      simple keyed-store server (the reference's ``SimplePSLogic`` overload).
+    * ``transform(data, worker, ps_logic, ...)`` — fully custom server
+      logic (event API).
+    * ``transform(batches, batched_worker, sharded_store, ...)`` — the
+      compiled TPU path.
+
+    ``iteration_wait_time`` is accepted for signature parity with the
+    reference but ignored: termination is explicit (input exhaustion), not a
+    silence timeout.
+    """
+    if isinstance(worker_logic, BatchedWorkerLogic):
+        if not isinstance(ps_logic, ShardedParamStore):
+            raise TypeError(
+                "batched worker logic requires a ShardedParamStore server"
+            )
+        return transform_batched(data, worker_logic, ps_logic, **batched_kwargs)
+
+    if ps_logic is None:
+        if param_init is None or param_update is None:
+            raise TypeError(
+                "provide either ps_logic or (param_init, param_update)"
+            )
+        ps_logic = lambda: SimplePSLogic(param_init, param_update)  # noqa: E731
+
+    workers = _instances(worker_logic, worker_parallelism, "worker")
+    servers = _instances(ps_logic, ps_parallelism, "ps")
+    runtime = _LocalRuntime(
+        workers,
+        servers,
+        partitioner,
+        input_window if input_window is not None else worker_parallelism,
+    )
+    runtime.run(data)
+    return TransformResult(
+        worker_outputs=runtime.worker_outputs,
+        server_outputs=runtime.server_outputs,
+    )
+
+
+def transform_with_model_load(
+    model: Iterable[Tuple[int, Any]],
+    data: Iterable,
+    worker_logic,
+    ps_logic=None,
+    **kwargs,
+) -> TransformResult:
+    """Seed the server from an initial ``(id, value)`` stream before
+    training — the reference's ``transformWithModelLoad`` overload
+    (SURVEY.md §2 #1, §5 "Checkpoint / resume").
+
+    For the batched path pass a ``ShardedParamStore`` built with
+    ``ShardedParamStore.from_values`` instead — this wrapper handles the
+    event API.
+    """
+    model = list(model)
+
+    if isinstance(ps_logic, ShardedParamStore):
+        table = ps_logic.table
+        ids = np.array([int(i) for i, _ in model])
+        vals = jnp.asarray(np.stack([np.asarray(v) for _, v in model]))
+        table = table.at[ids].set(vals.astype(table.dtype))
+        seeded = ShardedParamStore(ps_logic.spec, table)
+        return transform(data, worker_logic, seeded, **kwargs)
+
+    if ps_logic is None:
+        param_init = kwargs.pop("param_init", None)
+        param_update = kwargs.pop("param_update", None)
+        if param_init is None or param_update is None:
+            raise TypeError(
+                "provide either ps_logic or (param_init, param_update)"
+            )
+        ps_logic = lambda: SimplePSLogic(param_init, param_update)  # noqa: E731
+
+    # Event path: deliver the model stream as pushes before training data.
+    class _Seed(ParameterServer):
+        def __init__(self):
+            self.outs = []
+
+        def answer_pull(self, *a):  # pragma: no cover - seeds never pull
+            raise AssertionError("model-load phase must not answer pulls")
+
+        def output(self, o):
+            self.outs.append(o)
+
+    kwargs2 = dict(kwargs)
+    ps_par = kwargs2.get("ps_parallelism", 1)
+    servers = _instances(ps_logic, ps_par, "ps")
+    for pid, value in model:
+        target = servers[hash(pid) % ps_par]
+        if isinstance(target, SimplePSLogic):
+            # Model load *sets* the stored value (it is not a delta).
+            target.store[pid] = value
+        else:
+            target.on_push_recv(pid, value, _Seed())
+
+    def server_factory_iter():
+        for s in servers:
+            yield s
+
+    it = server_factory_iter()
+    kwargs2["ps_parallelism"] = ps_par
+    return transform(data, worker_logic, lambda: next(it), **kwargs2)
+
+
+__all__ = [
+    "TransformResult",
+    "transform",
+    "transform_batched",
+    "transform_with_model_load",
+    "make_train_step",
+]

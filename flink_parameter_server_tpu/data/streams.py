@@ -1,0 +1,91 @@
+"""Host-side data ingestion — the ``DataStream`` stand-in.
+
+Reference parity: the reference trains from a Flink ``DataStream[T]``
+(collection sources in tests, file/Kafka sources in examples — SURVEY.md
+§4, §2 #11).  The rebuild keeps a thin host-side streaming driver: plain
+Python iterables for the event backend, and microbatch iterators (numpy
+pytrees, static shapes) feeding the jitted step for the TPU backend —
+host→device transfer happens only at this edge (SURVEY.md §2 "TPU-native
+equivalent").
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+def from_collection(records: Sequence[Any]) -> Iterable[Any]:
+    """Parity helper for ``env.fromCollection`` (reference tests' source)."""
+    return list(records)
+
+
+def microbatches(
+    arrays: Dict[str, np.ndarray],
+    batch_size: int,
+    *,
+    epochs: int = 1,
+    drop_remainder: bool = False,
+    pad_value: int = 0,
+    shuffle_seed: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Slice column arrays into fixed-shape microbatches.
+
+    The last partial batch is zero-padded with a ``"mask"`` column added
+    (static shapes keep XLA from recompiling — SURVEY.md §7 "Dynamic
+    shapes"); set ``drop_remainder`` to skip it instead.
+    """
+    n = len(next(iter(arrays.values())))
+    for k, v in arrays.items():
+        assert len(v) == n, f"column {k} length {len(v)} != {n}"
+    rng = np.random.default_rng(shuffle_seed) if shuffle_seed is not None else None
+    for _ in range(epochs):
+        order = rng.permutation(n) if rng is not None else np.arange(n)
+        for start in range(0, n, batch_size):
+            idx = order[start : start + batch_size]
+            if len(idx) < batch_size:
+                if drop_remainder:
+                    break
+                pad = batch_size - len(idx)
+                batch = {
+                    k: np.concatenate(
+                        [v[idx], np.full((pad,) + v.shape[1:], pad_value, v.dtype)]
+                    )
+                    for k, v in arrays.items()
+                }
+                batch["mask"] = np.concatenate(
+                    [np.ones(len(idx), bool), np.zeros(pad, bool)]
+                )
+            else:
+                batch = {k: v[idx] for k, v in arrays.items()}
+                batch["mask"] = np.ones(batch_size, bool)
+            yield batch
+
+
+def prefetch(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
+    """Background-thread prefetch of host batches (keeps the device fed
+    while the host prepares the next microbatch)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    sentinel = object()
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        finally:
+            q.put(sentinel)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is sentinel:
+            return
+        yield item
+
+
+__all__ = ["from_collection", "microbatches", "prefetch"]

@@ -1,0 +1,221 @@
+"""Online matrix factorization on the parameter server.
+
+Reference parity (SURVEY.md §2 #7, §3.2/§3.3): the canonical example of
+``flink-parameter-server`` — ``PSOnlineMatrixFactorization.psOnlineMF``:
+
+  * **user vectors live in worker state** (partitioned across workers),
+  * **item vectors live on the PS** (sharded across server subtasks),
+  * per rating (u, i, r): pull item vector → SGD on the (user, item) pair →
+    update the local user vector, push the item delta,
+  * ``SGDUpdater`` carries learning rate + regularisation,
+  * per-id deterministic random init (ranged random factor descriptors).
+
+TPU-first mapping: a *microbatch of ratings* is one jitted step.  The user
+table is a dp-sharded ``(num_users, dim)`` array (worker state), the item
+table a ps-sharded :class:`ShardedParamStore`.  Pull is a sharded gather of
+the batch's item ids; the SGD math is one fused elementwise+matmul block on
+the MXU; user updates are a local scatter-add; item deltas are one sharded
+scatter-add push.  Duplicate users/items inside a batch combine additively —
+the same hogwild-style interleaving the reference embraces across workers
+(SURVEY.md §2 "Asynchrony"), here bounded to one microbatch.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.api import WorkerLogic
+from ..core.batched import BatchedWorkerLogic, PushRequest
+from ..core.store import ShardedParamStore
+from ..parallel.mesh import DP_AXIS
+from ..utils.initializers import ranged_random_factor
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDUpdater:
+    """The reference's ``SGDUpdater`` (learn rate + L2 regularisation) as a
+    pure vectorised function over a batch of (user_vec, item_vec, rating)."""
+
+    learning_rate: float = 0.01
+    regularization: float = 0.0
+
+    def delta(
+        self, rating: Array, user_vec: Array, item_vec: Array
+    ) -> Tuple[Array, Array, Array]:
+        """Returns (user_delta, item_delta, prediction); batch-shaped."""
+        pred = jnp.sum(user_vec * item_vec, axis=-1)
+        err = (rating - pred)[..., None]
+        lr = self.learning_rate
+        reg = self.regularization
+        user_delta = lr * (err * item_vec - reg * user_vec)
+        item_delta = lr * (err * user_vec - reg * item_vec)
+        return user_delta, item_delta, pred
+
+
+class OnlineMatrixFactorization(BatchedWorkerLogic):
+    """Batched MF worker logic: user factors = worker state, item factors =
+    PS store.  Batches are dicts with keys ``user``, ``item``, ``rating``,
+    ``mask`` (see :func:`..data.streams.microbatches`)."""
+
+    def __init__(
+        self,
+        num_users: int,
+        dim: int,
+        *,
+        updater: SGDUpdater = SGDUpdater(),
+        seed: int = 0,
+        init_low: float = -0.01,
+        init_high: float = 0.01,
+        mesh: Optional[Mesh] = None,
+        dp_axis: str = DP_AXIS,
+        dtype=jnp.float32,
+    ):
+        self.num_users = num_users
+        self.dim = dim
+        self.updater = updater
+        self.seed = seed
+        self.init_low = init_low
+        self.init_high = init_high
+        self.mesh = mesh
+        self.dp_axis = dp_axis
+        self.dtype = dtype
+
+    # -- BatchedWorkerLogic ------------------------------------------------
+    def init_state(self, rng: Array) -> Array:
+        init = ranged_random_factor(
+            self.seed, (self.dim,), low=self.init_low, high=self.init_high,
+            dtype=self.dtype,
+        )
+        ids = jnp.arange(self.num_users, dtype=jnp.int32)
+        if self.mesh is not None and self.dp_axis in self.mesh.axis_names:
+            sharding = NamedSharding(self.mesh, P(self.dp_axis, None))
+            return jax.jit(init, out_shardings=sharding)(ids)
+        return init(ids)
+
+    def keys(self, batch: Dict[str, Array]) -> Array:
+        return batch["item"]
+
+    def step(self, state: Array, batch: Dict[str, Array], pulled: Array):
+        users = batch["user"].astype(jnp.int32)
+        ratings = batch["rating"].astype(self.dtype)
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(users.shape, bool)
+
+        user_vecs = jnp.take(state, users, axis=0)
+        user_delta, item_delta, pred = self.updater.delta(
+            ratings, user_vecs, pulled
+        )
+        m = mask[..., None].astype(self.dtype)
+        state = state.at[users].add(user_delta * m, mode="drop")
+        out = {"prediction": pred, "error": (ratings - pred) * mask}
+        return state, PushRequest(batch["item"], item_delta, mask), out
+
+    def finish(self, state: Array):
+        # close()-time worker dump: the final user factors (the reference's
+        # workers emit updated (user, vector) records).
+        return {"user_factors": state}
+
+
+def ps_online_mf(
+    ratings,
+    *,
+    num_users: int,
+    num_items: int,
+    dim: int = 16,
+    learning_rate: float = 0.05,
+    regularization: float = 0.0,
+    seed: int = 0,
+    mesh: Optional[Mesh] = None,
+    **transform_kwargs,
+):
+    """End-to-end wrapper mirroring ``PSOnlineMatrixFactorization.psOnlineMF``
+    (SURVEY.md §3.3): build the item store + MF worker and run ``transform``.
+
+    ``ratings``: iterable of microbatch dicts (user, item, rating, mask).
+    Returns the :class:`TransformResult`; ``result.store.values()`` is the
+    final item-factor matrix, ``result.worker_state`` the user factors.
+    """
+    from ..core.transform import transform_batched
+
+    logic = OnlineMatrixFactorization(
+        num_users,
+        dim,
+        updater=SGDUpdater(learning_rate, regularization),
+        seed=seed,
+        mesh=mesh,
+    )
+    store = ShardedParamStore.create(
+        num_items,
+        (dim,),
+        init_fn=ranged_random_factor(seed + 1, (dim,)),
+        mesh=mesh,
+    )
+    return transform_batched(
+        ratings, logic, store, rng=jax.random.PRNGKey(seed), mesh=mesh,
+        **transform_kwargs,
+    )
+
+
+class MFWorkerLogic(WorkerLogic):
+    """Event-API MF worker — the literal reference programming model
+    (SURVEY.md §3.2): buffer the rating, pull the item vector, on answer run
+    SGD, update the local user vector, push the item delta.
+
+    Exists for semantics-parity tests and as the migration example from the
+    reference's callback style; the batched logic above is the TPU path.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        updater: SGDUpdater = SGDUpdater(),
+        seed: int = 0,
+        init_low: float = -0.01,
+        init_high: float = 0.01,
+    ):
+        self.dim = dim
+        self.updater = updater
+        self._init = ranged_random_factor(seed, (dim,), low=init_low, high=init_high)
+        self.user_vectors: Dict[int, Any] = {}
+        self.pending: Dict[int, list] = {}
+
+    def _user_vec(self, u: int):
+        if u not in self.user_vectors:
+            import numpy as np
+
+            self.user_vectors[u] = np.asarray(self._init(jnp.array([u]))[0])
+        return self.user_vectors[u]
+
+    def on_recv(self, data, ps):
+        u, i, r = data
+        self.pending.setdefault(i, []).append((u, r))
+        ps.pull(i)
+
+    def on_pull_recv(self, param_id, param_value, ps):
+        import numpy as np
+
+        item_vec = np.asarray(param_value)
+        for u, r in self.pending.pop(param_id, []):
+            user_vec = self._user_vec(u)
+            ud, idelta, pred = self.updater.delta(
+                jnp.asarray(r), jnp.asarray(user_vec), jnp.asarray(item_vec)
+            )
+            self.user_vectors[u] = user_vec + np.asarray(ud)
+            ps.push(param_id, np.asarray(idelta))
+            ps.output((u, param_id, float(pred)))
+
+
+__all__ = [
+    "SGDUpdater",
+    "OnlineMatrixFactorization",
+    "MFWorkerLogic",
+    "ps_online_mf",
+]

@@ -1,0 +1,1 @@
+"""flink_parameter_server_tpu.parallel"""

@@ -1,0 +1,83 @@
+"""Step metrics & observability.
+
+Reference parity (SURVEY.md §5 "Metrics / logging"): the reference exposes
+only Flink's operator metrics (throughput, backpressure).  The rebuild's
+north-star metrics (BASELINE.md) are measured here: updates/sec/chip and
+pull→push latency percentiles, plus a JSON-lines emitter as the
+"accumulator" analogue.
+"""
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class StepMetrics:
+    """Rolling throughput/latency tracker for the PS train loop.
+
+    ``events_per_step`` = microbatch size (one "event" = one reference
+    record: a rating, an example, a token pair).  Latency per step is the
+    full pull→compute→push round trip — the analogue of the reference's
+    per-message pull→push latency, amortised over the batch.
+    """
+
+    events_per_step: int
+    window: int = 100
+    _durations: List[float] = field(default_factory=list)
+    _t_last: Optional[float] = None
+    total_steps: int = 0
+    total_events: int = 0
+    started_at: float = field(default_factory=time.perf_counter)
+
+    def step_start(self) -> None:
+        self._t_last = time.perf_counter()
+
+    def step_end(self) -> None:
+        assert self._t_last is not None, "step_start() not called"
+        self._durations.append(time.perf_counter() - self._t_last)
+        if len(self._durations) > self.window:
+            self._durations.pop(0)
+        self.total_steps += 1
+        self.total_events += self.events_per_step
+
+    # -- reporting --------------------------------------------------------
+    def updates_per_sec(self) -> float:
+        if not self._durations:
+            return 0.0
+        return self.events_per_step * len(self._durations) / sum(self._durations)
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        if not self._durations:
+            return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+        d = np.array(self._durations)
+        return {
+            "p50": float(np.percentile(d, 50)),
+            "p90": float(np.percentile(d, 90)),
+            "p99": float(np.percentile(d, 99)),
+        }
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = self.latency_percentiles()
+        return {
+            "steps": self.total_steps,
+            "events": self.total_events,
+            "updates_per_sec": round(self.updates_per_sec(), 1),
+            "pull_push_p50_ms": round(lat["p50"] * 1e3, 3),
+            "pull_push_p90_ms": round(lat["p90"] * 1e3, 3),
+            "pull_push_p99_ms": round(lat["p99"] * 1e3, 3),
+            "wall_s": round(time.perf_counter() - self.started_at, 3),
+        }
+
+    def emit(self, sink=None) -> str:
+        line = json.dumps(self.snapshot())
+        if sink is not None:
+            sink.write(line + "\n")
+        return line
+
+
+__all__ = ["StepMetrics"]

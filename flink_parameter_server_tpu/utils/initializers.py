@@ -1,0 +1,63 @@
+"""Deterministic per-id parameter initializers.
+
+Reference parity: ``RangedRandomFactorInitializerDescriptor`` (SURVEY.md §2
+#7) — per-id deterministic random factor init so that any worker/server
+shard reproduces the same initial vector for a given id.  TPU-native
+analogue: counter-based PRNG via ``jax.random.fold_in`` on the id,
+vectorised over id arrays (no sequential RNG state, so it parallelises over
+the mesh trivially).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ranged_random_factor(
+    seed: int,
+    value_shape: Tuple[int, ...],
+    *,
+    low: float = -0.01,
+    high: float = 0.01,
+    dtype=jnp.float32,
+):
+    """``init_fn(ids) -> (n, *value_shape)`` uniform in ``[low, high)``,
+    deterministic per (seed, id)."""
+    base = jax.random.PRNGKey(seed)
+
+    def init(ids: jax.Array) -> jax.Array:
+        def one(i):
+            return jax.random.uniform(
+                jax.random.fold_in(base, i), value_shape, dtype, low, high
+            )
+
+        return jax.vmap(one)(ids.astype(jnp.uint32))
+
+    return init
+
+
+def normal_factor(seed: int, value_shape: Tuple[int, ...], *, stddev: float = 0.01,
+                  dtype=jnp.float32):
+    base = jax.random.PRNGKey(seed)
+
+    def init(ids: jax.Array) -> jax.Array:
+        def one(i):
+            return stddev * jax.random.normal(
+                jax.random.fold_in(base, i), value_shape, dtype
+            )
+
+        return jax.vmap(one)(ids.astype(jnp.uint32))
+
+    return init
+
+
+def zeros(value_shape: Tuple[int, ...], dtype=jnp.float32):
+    def init(ids: jax.Array) -> jax.Array:
+        return jnp.zeros(ids.shape + value_shape, dtype)
+
+    return init
+
+
+__all__ = ["ranged_random_factor", "normal_factor", "zeros"]

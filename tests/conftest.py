@@ -1,0 +1,50 @@
+"""Test harness: 8 virtual CPU devices = the "MiniCluster equivalent".
+
+The reference tests distributed behavior on Flink's in-JVM MiniCluster
+(real operator parallelism, local channels — SURVEY.md §4).  Our analogue:
+XLA's CPU backend with a forced host device count gives real pjit shardings
+and real collectives without TPU hardware.
+
+Environment quirk: this image injects a ``sitecustomize`` that imports jax
+at interpreter start with ``JAX_PLATFORMS`` pinned to a remote-TPU platform
+whose first backend init blocks on the TPU tunnel.  Env edits in conftest
+are too late (jax's config already captured the env), so we override via
+``jax.config.update`` before any backend is initialized.  Set
+``FPS_TPU_TESTS=1`` to run the suite on the real backend instead.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+if os.environ.get("FPS_TPU_TESTS") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, devs
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    """2 workers (dp) x 4 ps shards — both reference parallelism knobs >1."""
+    from flink_parameter_server_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(worker_parallelism=2, ps_parallelism=4)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,141 @@
+"""ShardedParamStore unit tests: pull/push semantics, sharding, init.
+
+Mirrors the reference's server-side semantics (SimplePSLogic:
+getOrElseUpdate + user update fn — SURVEY.md §2 #3) at microbatch
+granularity.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.parallel.collectives import (
+    shard_pull,
+    shard_push_add,
+)
+from flink_parameter_server_tpu.utils.initializers import (
+    ranged_random_factor,
+    zeros,
+)
+
+
+def test_pull_returns_initialized_values():
+    init = ranged_random_factor(seed=7, value_shape=(4,), low=-0.5, high=0.5)
+    store = ShardedParamStore.create(100, (4,), init_fn=init)
+    ids = jnp.array([3, 17, 3, 99])
+    vals = store.pull(ids)
+    assert vals.shape == (4, 4)
+    # Deterministic per id: duplicate ids pull identical vectors.
+    np.testing.assert_allclose(vals[0], vals[2])
+    # And match a fresh evaluation of the initializer.
+    np.testing.assert_allclose(np.asarray(vals), np.asarray(init(ids)), rtol=1e-6)
+
+
+def test_push_add_with_duplicates_matches_sequential():
+    store = ShardedParamStore.create(10, (2,), init_fn=zeros((2,)))
+    ids = jnp.array([1, 1, 3, 1])
+    deltas = jnp.array([[1.0, 0.0], [2.0, 0.0], [5.0, 5.0], [4.0, 1.0]])
+    out = store.push(ids, deltas)
+    expect = np.zeros((10, 2))
+    for i, d in zip([1, 1, 3, 1], np.asarray(deltas)):
+        expect[i] += d  # sequential reference semantics; add is commutative
+    np.testing.assert_allclose(np.asarray(out.values()), expect)
+
+
+def test_push_mask_drops_padding_lanes():
+    store = ShardedParamStore.create(8, (), init_fn=zeros(()))
+    ids = jnp.array([2, 5, 0])
+    deltas = jnp.array([10.0, 20.0, 99.0])
+    mask = jnp.array([True, True, False])
+    out = store.push(ids, deltas, mask)
+    got = np.asarray(out.values())
+    assert got[2] == 10.0 and got[5] == 20.0 and got[0] == 0.0
+
+
+def test_generic_update_fn():
+    # Custom non-add update: exponential moving average of combined deltas.
+    def ema(current, combined):
+        return 0.5 * current + 0.5 * combined
+
+    store = ShardedParamStore.create(6, (), init_fn=zeros(()), update=ema)
+    store = store.push(jnp.array([0, 1]), jnp.array([8.0, 4.0]))
+    got = np.asarray(store.values())
+    assert got[0] == 4.0 and got[1] == 2.0
+    # Untouched rows must remain untouched by the generic dense path.
+    assert got[2] == 0.0
+    store = store.push(jnp.array([0]), jnp.array([0.0]))
+    assert np.asarray(store.values())[0] == 2.0
+
+
+def test_sharded_store_matches_single_device(mesh):
+    init = ranged_random_factor(seed=3, value_shape=(8,))
+    sharded = ShardedParamStore.create(64, (8,), init_fn=init, mesh=mesh)
+    local = ShardedParamStore.create(64, (8,), init_fn=init)
+    np.testing.assert_allclose(
+        np.asarray(sharded.values()), np.asarray(local.values()), rtol=1e-6
+    )
+    ids = jnp.array([0, 5, 63, 31, 5])
+    deltas = jnp.ones((5, 8))
+    a = sharded.push(ids, deltas)
+    b = local.push(ids, deltas)
+    np.testing.assert_allclose(np.asarray(a.values()), np.asarray(b.values()), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(a.pull(ids)), np.asarray(b.pull(ids)), rtol=1e-6
+    )
+
+
+def test_from_values_model_load(mesh):
+    values = jnp.arange(20.0).reshape(10, 2)
+    store = ShardedParamStore.from_values(values, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(store.values()), np.asarray(values))
+    np.testing.assert_allclose(
+        np.asarray(store.pull(jnp.array([7]))), [[14.0, 15.0]]
+    )
+
+
+class TestExplicitCollectives:
+    """shard_map pull/push — the explicit ICI message plane."""
+
+    def test_shard_pull_matches_take(self, mesh):
+        table = jnp.arange(64 * 4, dtype=jnp.float32).reshape(64, 4)
+        store = ShardedParamStore.from_values(table, mesh=mesh)
+        # ids: leading dim sharded over dp (2 workers x 3 ids each)
+        ids = jnp.array([[0, 17, 63], [5, 5, 32]], dtype=jnp.int32)
+        got = shard_pull(store.table, ids, mesh=mesh)
+        want = jnp.take(table, ids.reshape(-1), axis=0).reshape(2, 3, 4)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+    def test_shard_push_matches_scatter_add(self, mesh):
+        table = jnp.zeros((64, 4), jnp.float32)
+        store = ShardedParamStore.from_values(table, mesh=mesh)
+        ids = jnp.array([[1, 1, 40], [40, 2, 63]], dtype=jnp.int32)
+        deltas = jnp.ones((2, 3, 4), jnp.float32)
+        mask = jnp.array([[True, True, True], [True, True, False]])
+        got = shard_push_add(store.table, ids, deltas, mask, mesh=mesh)
+        want = np.zeros((64, 4))
+        for i, m in zip(np.asarray(ids).reshape(-1), np.asarray(mask).reshape(-1)):
+            if m:
+                want[i] += 1.0
+        np.testing.assert_allclose(np.asarray(got), want)
+
+    def test_pull_under_jit(self, mesh):
+        table = jnp.arange(64.0).reshape(64, 1)
+        store = ShardedParamStore.from_values(table, mesh=mesh)
+        ids = jnp.array([[3, 9], [60, 0]], dtype=jnp.int32)
+
+        f = jax.jit(lambda t, i: shard_pull(t, i, mesh=mesh))
+        got = f(store.table, ids)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(-1), [3.0, 9.0, 60.0, 0.0]
+        )
+
+
+def test_push_out_of_range_ids_are_dropped():
+    """OOB pushes must be dropped (mode='drop'), not clipped onto a real
+    row — parity with shard_push_add's hit-mask semantics."""
+    store = ShardedParamStore.create(10, (), init_fn=zeros(()))
+    out = store.push(jnp.array([50, -3, 9]), jnp.array([1.0, 1.0, 2.0]))
+    got = np.asarray(out.values())
+    assert got[9] == 2.0
+    assert got.sum() == 2.0  # nothing else was touched
