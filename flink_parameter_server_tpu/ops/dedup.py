@@ -1,0 +1,53 @@
+"""Intra-batch duplicate-id handling — the "combination sender" layer.
+
+Reference parity (SURVEY.md §2 #6, §7 step 4): the reference's batching
+("combination") senders buffer pull/push messages and flush them combined
+on count/timer triggers.  In the batched TPU model the *microbatch itself*
+is the combination buffer; what remains of the concern is how duplicate
+ids inside one microbatch combine.
+
+By default deltas for the same id SUM (exact minibatch SGD — every
+gradient was computed at the same pulled snapshot).  Under Zipf-hot id
+distributions (word2vec, Criteo) a hot id can appear hundreds of times per
+batch, making its effective step ~count × lr and destabilising training at
+learning rates that are fine sequentially.  ``occurrence_scale`` gives the
+mean-combining alternative: scale each lane's delta by 1/count(id) so a
+hot id takes one averaged step per batch — bounded regardless of skew.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def occurrence_counts(
+    ids: Array, capacity: int, mask: Optional[Array] = None
+) -> Array:
+    """Per-lane occurrence count of each lane's id within the batch.
+
+    ``ids``: any-shape int array; returns same-shape float32 counts
+    (≥ 1 for valid lanes).  O(capacity) scratch — intended for id spaces
+    that fit a dense counter (vocab/feature tables), not 2^30 hash spaces.
+    """
+    flat = ids.reshape(-1).astype(jnp.int32)
+    flat = jnp.where(flat < 0, capacity, flat)  # OOB sentinel, drops
+    ones = jnp.ones(flat.shape, jnp.float32)
+    if mask is not None:
+        ones = jnp.where(mask.reshape(-1), ones, 0.0)
+    table = jnp.zeros((capacity,), jnp.float32).at[flat].add(ones, mode="drop")
+    counts = jnp.take(table, jnp.clip(flat, 0, capacity - 1), axis=0)
+    return jnp.maximum(counts, 1.0).reshape(ids.shape)
+
+
+def occurrence_scale(
+    ids: Array, capacity: int, mask: Optional[Array] = None
+) -> Array:
+    """1/count(id) per lane: turns duplicate-id delta *sums* into *means*."""
+    return 1.0 / occurrence_counts(ids, capacity, mask)
+
+
+__all__ = ["occurrence_counts", "occurrence_scale"]

@@ -1,0 +1,179 @@
+"""word2vec SGNS and factorization-machine tests (BASELINE configs 3, 4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.data.text import (
+    skipgram_batches,
+    synthetic_corpus,
+)
+from flink_parameter_server_tpu.models.factorization_machine import (
+    FMConfig,
+    train_fm,
+)
+from flink_parameter_server_tpu.models.word2vec import (
+    IN,
+    train_skipgram,
+    sample_negatives,
+)
+
+
+def test_sgns_loss_decreases():
+    vocab = 300
+    tokens = synthetic_corpus(vocab, 20_000, num_topics=6, seed=0)
+    losses = []
+
+    res = train_skipgram(
+        skipgram_batches(tokens, vocab, batch_size=512, epochs=2, seed=0),
+        vocab_size=vocab,
+        dim=16,
+        learning_rate=0.05,
+        on_step=lambda i, out: losses.append(float(jnp.mean(out["loss"]))),
+        collect_outputs=False,
+    )
+    first = np.mean(losses[:5])
+    last = np.mean(losses[-5:])
+    assert last < 0.8 * first, (first, last)
+    emb = np.asarray(res.store.values())
+    assert emb.shape == (vocab, 2, 16)
+
+
+def test_sgns_topical_structure():
+    """Words from the same planted topic should embed closer than words
+    from different topics."""
+    vocab, topics = 200, 4
+    tokens = synthetic_corpus(
+        vocab, 60_000, num_topics=topics, topic_stickiness=0.995, seed=1
+    )
+    res = train_skipgram(
+        skipgram_batches(tokens, vocab, batch_size=512, window=3, epochs=3, seed=1),
+        vocab_size=vocab,
+        dim=16,
+        learning_rate=0.05,
+        collect_outputs=False,
+    )
+    emb = np.asarray(res.store.values())[:, IN]
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9)
+    wpt = vocab // topics
+    # frequent words (low rank within topic) carry the signal
+    same, diff = [], []
+    for t in range(topics):
+        a, b = t * wpt, t * wpt + 1
+        same.append(float(emb[a] @ emb[b]))
+        other = ((t + 1) % topics) * wpt
+        diff.append(float(emb[a] @ emb[other]))
+    assert np.mean(same) > np.mean(diff) + 0.2, (same, diff)
+
+
+def test_sample_negatives_follows_cdf():
+    probs = np.array([0.5, 0.25, 0.125, 0.125])
+    cdf = jnp.asarray(np.cumsum(probs))
+    s = sample_negatives(jax.random.PRNGKey(0), cdf, (20_000,))
+    freq = np.bincount(np.asarray(s), minlength=4) / 20_000
+    np.testing.assert_allclose(freq, probs, atol=0.02)
+
+
+def _fm_batches(rng, n, num_feats, k, w, V, batch=256, epochs=1):
+    for _ in range(epochs):
+        for s in range(0, n, batch):
+            B = batch
+            ids = rng.integers(0, num_feats, (B, k)).astype(np.int32)
+            vals = np.ones((B, k), np.float32)
+            fm = np.ones((B, k), bool)
+            lin = w[ids].sum(1)
+            inter = np.zeros(B)
+            for b in range(B):
+                vv = V[ids[b]]
+                s_ = vv.sum(0)
+                inter[b] = 0.5 * ((s_ @ s_) - (vv * vv).sum())
+            y = np.sign(lin + inter + 1e-9)
+            yield {
+                "ids": ids,
+                "values": vals,
+                "feat_mask": fm,
+                "label": y.astype(np.float32),
+                "mask": np.ones(B, bool),
+            }
+
+
+def test_fm_learns_synthetic_interactions():
+    rng = np.random.default_rng(3)
+    F, k = 60, 5
+    w_true = rng.normal(0, 1, F)
+    V_true = rng.normal(0, 0.5, (F, 4))
+    cfg = FMConfig(num_features=F, dim=4, learning_rate=0.05)
+    res = train_fm(
+        _fm_batches(rng, 6 * 2048, F, k, w_true, V_true, epochs=1),
+        cfg,
+        collect_outputs=False,
+    )
+    rng2 = np.random.default_rng(3)
+    # regenerate a fresh eval batch from the same ground truth
+    eval_batch = next(_fm_batches(rng2, 2048, F, k, w_true, V_true))
+    model = np.asarray(res.store.values())
+    w, V = model[:, 0], model[:, 1:]
+    ids = eval_batch["ids"]
+    lin = w[ids].sum(1)
+    inter = np.array(
+        [0.5 * ((V[i].sum(0) @ V[i].sum(0)) - (V[i] * V[i]).sum()) for i in ids]
+    )
+    acc = np.mean(np.sign(lin + inter) == eval_batch["label"])
+    assert acc > 0.75, acc
+
+
+def test_fm_squared_loss_gradient_check():
+    """FM step gradient vs jax.grad of the same objective (squared loss)."""
+    from flink_parameter_server_tpu.models.factorization_machine import (
+        FactorizationMachine,
+    )
+
+    cfg = FMConfig(num_features=10, dim=3, learning_rate=1.0, loss="squared")
+    logic = FactorizationMachine(cfg)
+    rng = np.random.default_rng(0)
+    pulled = jnp.asarray(rng.normal(0, 0.5, (2, 4, 4)).astype(np.float32))
+    batch = {
+        "ids": jnp.asarray(rng.integers(0, 10, (2, 4)).astype(np.int32)),
+        "values": jnp.asarray(rng.normal(0, 1, (2, 4)).astype(np.float32)),
+        "feat_mask": jnp.ones((2, 4), bool),
+        "label": jnp.asarray([0.3, -0.7], jnp.float32),
+        "mask": jnp.ones(2, bool),
+    }
+
+    def objective(p):
+        x = batch["values"]
+        w, v = p[..., 0], p[..., 1:]
+        lin = jnp.sum(w * x, -1)
+        xv = x[..., None] * v
+        s = xv.sum(1)
+        inter = 0.5 * (jnp.sum(s * s, -1) - jnp.sum(xv * xv, (1, 2)))
+        return jnp.sum(0.5 * (lin + inter - batch["label"]) ** 2)
+
+    want = -jax.grad(objective)(pulled)  # lr = 1, delta = -grad
+    _, req, _ = logic.step((), batch, pulled)
+    np.testing.assert_allclose(np.asarray(req.deltas), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_sgns_dedup_scale_stabilizes_high_lr():
+    """Summed duplicate deltas diverge at lr=0.1 on a Zipf corpus; the
+    occurrence-mean combiner (the combination-sender analogue) keeps the
+    same lr stable."""
+    vocab = 300
+    tokens = synthetic_corpus(vocab, 20_000, num_topics=6, seed=0)
+    from flink_parameter_server_tpu.models.word2vec import SkipGramNS, make_store
+    from flink_parameter_server_tpu.core.transform import transform_batched
+
+    losses = []
+    # lr=0.1 with summed duplicates diverges (see ops/dedup.py docstring);
+    # with mean-combining even lr=1.0 is stable and converges fast.
+    logic = SkipGramNS(1.0, dedup_scale=True, vocab_size=vocab)
+    transform_batched(
+        skipgram_batches(tokens, vocab, batch_size=512, epochs=2, seed=0),
+        logic,
+        make_store(vocab, 16, seed=0),
+        on_step=lambda i, o: losses.append(float(jnp.mean(o["loss"]))),
+        collect_outputs=False,
+        dump_model=False,
+    )
+    assert max(losses) < 10.0, max(losses)  # no explosion
+    assert np.mean(losses[-5:]) < 0.8 * np.mean(losses[:5])
