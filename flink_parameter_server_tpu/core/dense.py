@@ -1,0 +1,122 @@
+"""DenseParameterServer — the PS API stretched to dense model pytrees.
+
+Reference parity: BASELINE.json config #5 ("Transformer-base LM
+data-parallel — dense allreduce — stretch the PS API").  The keyed
+``pull(id)/push(id, delta)`` protocol degenerates, for a dense model, to
+"pull everything / push one gradient": the server is the full parameter
+pytree plus an optimizer, and a push folds the (dp-allreduced) gradient
+through the optimizer update.  The allreduce is not written anywhere —
+jit + dp-sharded batch shardings make XLA insert the psum over ICI, the
+collective-native replacement for the reference's per-key Netty routing.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+import jax
+import optax
+
+from .transform import TransformResult
+
+Array = jax.Array
+PyTree = Any
+
+
+def jnp_copy(x):
+    """Device-resident copy preserving sharding (for donation safety)."""
+    import jax.numpy as jnp
+
+    return jnp.copy(x) if isinstance(x, jax.Array) else x
+
+
+class DenseParameterServer:
+    """Functional (params, opt_state, optimizer) bundle with pull/push.
+
+    ``pull()`` → the model pytree; ``push(grads)`` → new server with the
+    optimizer update applied.  Same contract shape as
+    :class:`ShardedParamStore`, with the id space collapsed to "all".
+    """
+
+    def __init__(
+        self,
+        params: PyTree,
+        optimizer: optax.GradientTransformation,
+        opt_state: Optional[PyTree] = None,
+    ):
+        self.params = params
+        self.optimizer = optimizer
+        self.opt_state = (
+            opt_state if opt_state is not None else optimizer.init(params)
+        )
+
+    def pull(self) -> PyTree:
+        return self.params
+
+    def push(self, grads: PyTree) -> "DenseParameterServer":
+        updates, new_opt_state = self.optimizer.update(
+            grads, self.opt_state, self.params
+        )
+        new_params = optax.apply_updates(self.params, updates)
+        return DenseParameterServer(new_params, self.optimizer, new_opt_state)
+
+    def values(self) -> PyTree:
+        """Close-time model dump (reference §3.5)."""
+        return self.params
+
+
+def make_dense_train_step(
+    loss_fn: Callable[[PyTree, Any], Array],
+    optimizer: optax.GradientTransformation,
+) -> Callable:
+    """Fused pull → grad → push step (jit this).  ``loss_fn(params,
+    batch) -> scalar``; gradients are averaged across the dp axis by XLA
+    from the shardings alone."""
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step
+
+
+def transform_dense(
+    data: Iterable,
+    loss_fn: Callable[[PyTree, Any], Array],
+    server: DenseParameterServer,
+    *,
+    batch_sharding=None,
+    on_step: Optional[Callable[[int, Array], None]] = None,
+) -> TransformResult:
+    """The ``transform`` loop for the dense case: one jitted
+    pull→grad→push per microbatch; returns losses as worker outputs and
+    the final model as the server dump."""
+    step = jax.jit(
+        make_dense_train_step(loss_fn, server.optimizer),
+        donate_argnums=(0, 1),
+    )
+    # The jitted step donates its (params, opt_state) arguments; start from
+    # copies so the caller's server survives (it is a read-only input).
+    params = jax.tree.map(jnp_copy, server.params)
+    opt_state = jax.tree.map(jnp_copy, server.opt_state)
+    losses: List[Any] = []
+    for i, batch in enumerate(data):
+        if batch_sharding is not None:
+            batch = jax.tree.map(
+                lambda x: jax.device_put(x, batch_sharding), batch
+            )
+        params, opt_state, loss = step(params, opt_state, batch)
+        if on_step is not None:
+            on_step(i, loss)
+        losses.append(loss)
+    final = DenseParameterServer(params, server.optimizer, opt_state)
+    return TransformResult(
+        worker_outputs=losses,
+        server_outputs=[final.values()],
+        store=None,
+        worker_state=None,
+    )
+
+
+__all__ = ["DenseParameterServer", "make_dense_train_step", "transform_dense"]

@@ -1,0 +1,232 @@
+"""Decoder-only Transformer LM — the dense data-parallel config.
+
+Reference parity: BASELINE.json config #5 ("Transformer-base LM
+data-parallel — dense allreduce — stretch the PS API").  The model trains
+through :class:`..core.dense.DenseParameterServer` (pull all / push grad);
+this module supplies the TPU-shaped model itself.
+
+TPU-first layout (Megatron-style named-axis sharding, XLA inserts the
+collectives):
+
+  * ``dp``  — batch;  gradients psum over dp = the "dense allreduce".
+  * ``tp``  — attention heads + MLP hidden: QKV/up projections column
+    -sharded ``P(None, 'tp')``, output/down row-sharded ``P('tp', None)``.
+  * ``sp``  — sequence: activations sharded on T; attention runs
+    :func:`..parallel.ring_attention.ring_attention` over the ICI ring
+    (long-context support the reference never had).
+
+bfloat16 parameters/activations with fp32 RMSNorm/softmax accumulation —
+the MXU-native dtype choice.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring_attention import reference_attention, ring_attention
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_seq: int = 1024
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+    dp_axis: Optional[str] = "dp"
+    tp_axis: Optional[str] = None
+    sp_axis: Optional[str] = None
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def _spec(mesh: Optional[Mesh], *axes) -> Optional[NamedSharding]:
+    if mesh is None:
+        return None
+    axes = tuple(a if a in mesh.axis_names else None for a in axes)
+    return NamedSharding(mesh, P(*axes))
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Optional[Mesh]) -> Dict:
+    """Named-axis sharding tree for the parameter pytree."""
+    tp = cfg.tp_axis
+    layer = {
+        "attn_norm": _spec(mesh, None),
+        "wqkv": _spec(mesh, None, tp),  # column parallel
+        "wo": _spec(mesh, tp, None),  # row parallel
+        "mlp_norm": _spec(mesh, None),
+        "w_up": _spec(mesh, None, tp),
+        "w_down": _spec(mesh, tp, None),
+    }
+    return {
+        "embed": _spec(mesh, None, None),
+        "final_norm": _spec(mesh, None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def init_params(rng: Array, cfg: TransformerConfig, mesh: Optional[Mesh] = None) -> Dict:
+    """Initialise the parameter pytree, placed onto its shardings."""
+    k_embed, k_layers = jax.random.split(rng)
+    d, h, f = cfg.d_model, cfg.n_heads, cfg.d_ff
+
+    def dense(key, shape, scale):
+        return (scale * jax.random.normal(key, shape, jnp.float32)).astype(
+            cfg.dtype
+        )
+
+    layers = []
+    for i in range(cfg.n_layers):
+        k = jax.random.fold_in(k_layers, i)
+        k1, k2, k3, k4 = jax.random.split(k, 4)
+        layers.append(
+            {
+                "attn_norm": jnp.ones((d,), jnp.float32),
+                "wqkv": dense(k1, (d, 3 * d), d**-0.5),
+                "wo": dense(k2, (d, d), (2 * cfg.n_layers * d) ** -0.5),
+                "mlp_norm": jnp.ones((d,), jnp.float32),
+                "w_up": dense(k3, (d, f), d**-0.5),
+                "w_down": dense(k4, (f, d), (2 * cfg.n_layers * f) ** -0.5),
+            }
+        )
+    params = {
+        # small embed init: with tied output weights a unit-scale embedding
+        # makes initial logits (and loss) explode
+        "embed": dense(k_embed, (cfg.vocab_size, d), 0.02),
+        "final_norm": jnp.ones((d,), jnp.float32),
+        "layers": layers,
+    }
+    shardings = param_shardings(cfg, mesh)
+    if mesh is not None:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(x, s) if s is not None else x,
+            params,
+            shardings,
+        )
+    return params
+
+
+def _rmsnorm(x: Array, gain: Array) -> Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (xf * scale * gain).astype(x.dtype)
+
+
+def _rope(x: Array, positions: Array) -> Array:
+    """Rotary position embedding on (B, T, H, D)."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # B,T,1,half
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def forward(
+    params: Dict,
+    tokens: Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Optional[Mesh] = None,
+) -> Array:
+    """Causal LM forward: (B, T) int tokens → (B, T, vocab) fp32 logits.
+
+    With ``cfg.sp_axis`` set, T is sharded over ``sp`` and positions are
+    global (the caller shards tokens with ``P(dp, sp)``).
+    """
+    B, T = tokens.shape
+    assert T <= cfg.max_seq, f"sequence length {T} > max_seq {cfg.max_seq}"
+    H, Dh = cfg.n_heads, cfg.head_dim
+
+    act_spec = None
+    if mesh is not None:
+        act_spec = P(
+            cfg.dp_axis if cfg.dp_axis in mesh.axis_names else None,
+            cfg.sp_axis if (cfg.sp_axis and cfg.sp_axis in mesh.axis_names) else None,
+            None,
+        )
+
+    def constrain(x, spec=None):
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec if spec is not None else act_spec)
+        )
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    x = constrain(x)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+
+    for layer in params["layers"]:
+        h = _rmsnorm(x, layer["attn_norm"])
+        qkv = h @ layer["wqkv"]  # (B, T, 3·d)
+        qkv = qkv.reshape(B, T, 3, H, Dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = _rope(q, positions)
+        k = _rope(k, positions)
+        if cfg.use_ring_attention and mesh is not None and cfg.sp_axis:
+            attn = ring_attention(
+                q, k, v,
+                mesh=mesh,
+                sp_axis=cfg.sp_axis,
+                dp_axis=cfg.dp_axis if cfg.dp_axis in mesh.axis_names else None,
+                tp_axis=cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None,
+            )
+        else:
+            attn = reference_attention(q, k, v)
+        attn = attn.reshape(B, T, H * Dh)
+        x = x + attn @ layer["wo"]
+        x = constrain(x)
+
+        h = _rmsnorm(x, layer["mlp_norm"])
+        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+        x = constrain(x)
+
+    x = _rmsnorm(x, params["final_norm"])
+    logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    return logits
+
+
+def lm_loss(params: Dict, batch: Dict[str, Array], cfg: TransformerConfig,
+            *, mesh: Optional[Mesh] = None) -> Array:
+    """Next-token cross entropy.  Batch: ``tokens`` (B, T) with targets =
+    tokens shifted left; last position masked."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, mesh=mesh)
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    if "mask" in batch:
+        row_mask = batch["mask"]
+        if row_mask.ndim == 1:  # (B,) row mask from microbatches()
+            row_mask = row_mask[:, None]
+        mask = mask * row_mask
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "param_shardings",
+    "forward",
+    "lm_loss",
+]
