@@ -55,6 +55,10 @@ class StoreSpec:
     # generic dense-update path (see module docstring; intra-batch
     # duplicate deltas are always summed before `update` is applied).
     update: Union[str, UpdateFn] = "add"
+    # "xla" = native XLA scatter; "pallas" = the sorted-run duplicate
+    # -compressing TPU kernel (ops/pallas_scatter.py) — wins under Zipf-hot
+    # id distributions; only valid with update="add" and vector values.
+    scatter_impl: str = "xla"
     mesh: Optional[Mesh] = None
     ps_axis: str = "ps"
 
@@ -145,6 +149,17 @@ def push(
         )
 
     if spec.update == "add":
+        if spec.scatter_impl == "pallas" and spec.num_shards == 1:
+            # The pallas kernel is per-device; under a >1-shard mesh it
+            # would silently unshard the table (full allgather per push),
+            # so sharded stores stay on the XLA scatter until the kernel
+            # is wrapped in shard_map (future round).
+            from ..ops.pallas_scatter import scatter_add as pallas_scatter_add
+
+            return pallas_scatter_add(
+                table, flat_ids, flat_deltas,
+                None if mask is None else flat_mask,
+            )
         return table.at[flat_ids].add(
             flat_deltas.astype(table.dtype), mode="drop"
         )
@@ -191,6 +206,7 @@ class ShardedParamStore:
         dtype: Any = jnp.float32,
         init_fn: Optional[InitFn] = None,
         update: Union[str, UpdateFn] = "add",
+        scatter_impl: str = "xla",
         mesh: Optional[Mesh] = None,
         ps_axis: str = "ps",
     ) -> "ShardedParamStore":
@@ -199,6 +215,7 @@ class ShardedParamStore:
             value_shape=tuple(value_shape),
             dtype=dtype,
             update=update,
+            scatter_impl=scatter_impl,
             mesh=mesh,
             ps_axis=ps_axis,
         )

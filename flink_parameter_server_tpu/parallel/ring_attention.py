@@ -113,9 +113,10 @@ def ring_attention(
             else:
                 mask = full
             m, l, o = _block_attention_update(qh, kh, vh, mask, m, l, o, scale)
-            perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
-            kh = jax.lax.ppermute(kh, sp_axis, perm)
-            vh = jax.lax.ppermute(vh, sp_axis, perm)
+            if j < num_blocks - 1:  # final rotation's result is never read
+                perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+                kh = jax.lax.ppermute(kh, sp_axis, perm)
+                vh = jax.lax.ppermute(vh, sp_axis, perm)
             return m, l, o, kh, vh
 
         # unrolled python loop: num_blocks is static and small; lets XLA

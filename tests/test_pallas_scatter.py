@@ -1,0 +1,76 @@
+"""Pallas sorted-run scatter-add kernel tests (interpret mode on CPU).
+
+The kernel is the rebuild's "native component" (SURVEY.md §7): one HBM
+read-modify-write per unique id.  Small chunk sizes here force runs to
+span chunk boundaries, exercising the carry path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.ops.pallas_scatter import scatter_add
+
+
+def _oracle(table, ids, deltas, mask=None):
+    out = np.array(table)
+    for i, (r, d) in enumerate(zip(np.asarray(ids), np.asarray(deltas))):
+        if mask is not None and not bool(np.asarray(mask)[i]):
+            continue
+        if 0 <= r < out.shape[0]:
+            out[r] += d
+    return out
+
+
+@pytest.mark.parametrize("chunk", [4, 16, 512])
+def test_matches_oracle_random(chunk):
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(0, 1, (32, 8)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, 32, 50).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (50, 8)).astype(np.float32))
+    got = scatter_add(table, ids, deltas, chunk=chunk, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), _oracle(table, ids, deltas), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_hot_id_run_spanning_chunks():
+    """One id occupying several chunks (the Zipf-hot case): the carry
+    state must survive chunk boundaries."""
+    table = jnp.zeros((8, 4), jnp.float32)
+    ids = jnp.full((40,), 3, jnp.int32)
+    deltas = jnp.ones((40, 4), jnp.float32)
+    got = scatter_add(table, ids, deltas, chunk=8, interpret=True)
+    want = np.zeros((8, 4))
+    want[3] = 40.0
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_mask_and_oob_dropped():
+    rng = np.random.default_rng(1)
+    table = jnp.asarray(rng.normal(0, 1, (16, 4)).astype(np.float32))
+    ids = jnp.asarray([0, -2, 99, 5, 5], jnp.int32)
+    deltas = jnp.asarray(rng.normal(0, 1, (5, 4)).astype(np.float32))
+    mask = jnp.asarray([True, True, True, True, False])
+    got = scatter_add(table, ids, deltas, mask, chunk=4, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got), _oracle(table, ids, deltas, mask), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_store_pallas_impl_matches_xla():
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.utils.initializers import zeros
+
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(((rng.zipf(1.3, 200) - 1) % 30).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (200, 4)).astype(np.float32))
+    s_xla = ShardedParamStore.create(30, (4,), init_fn=zeros((4,)))
+    s_pl = ShardedParamStore.create(
+        30, (4,), init_fn=zeros((4,)), scatter_impl="pallas"
+    )
+    a = s_xla.push(ids, deltas)
+    b = s_pl.push(ids, deltas)
+    np.testing.assert_allclose(
+        np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-4
+    )
