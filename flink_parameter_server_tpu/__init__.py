@@ -19,6 +19,7 @@ from .core.api import (
     add_pull_limiter,
 )
 from .core.batched import BatchedWorkerLogic, PushRequest
+from .core.dense import DenseParameterServer, transform_dense
 from .core.entities import Pull, PullAnswer, Push, PSToWorker, WorkerToPS
 from .core.store import ShardedParamStore, StoreSpec
 from .core.transform import (
@@ -28,6 +29,7 @@ from .core.transform import (
     transform_with_model_load,
 )
 from .parallel.mesh import DP_AXIS, PS_AXIS, make_mesh
+from .training.driver import DriverConfig, StreamingDriver
 
 __version__ = "0.1.0"
 
@@ -54,4 +56,8 @@ __all__ = [
     "make_mesh",
     "DP_AXIS",
     "PS_AXIS",
+    "DenseParameterServer",
+    "transform_dense",
+    "DriverConfig",
+    "StreamingDriver",
 ]

@@ -235,14 +235,29 @@ def transform_batched(
     collect_outputs: bool = True,
     dump_model: bool = True,
     on_step: Optional[Callable[[int, Any], None]] = None,
+    state_callback: Optional[Callable[[int, Any, Any, Any], None]] = None,
+    initial_state: Any = None,
+    skip_batches: int = 0,
 ) -> TransformResult:
-    """Run the compiled PS loop over an iterable of microbatches."""
+    """Run the compiled PS loop over an iterable of microbatches.
+
+    ``state_callback(step_idx, table, state, out)`` additionally sees the
+    live (donated-next-step) table/state — the hook the StreamingDriver
+    uses for metrics, checkpoints and profiling windows without
+    duplicating this loop.  ``skip_batches`` fast-forwards the iterator
+    (resume-from-cursor); ``initial_state`` overrides
+    ``worker_logic.init_state`` (restored worker state).
+    """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     spec = store.spec
     mesh = mesh or spec.mesh
 
     step = jax.jit(make_train_step(worker_logic, spec), donate_argnums=(0, 1))
-    state = worker_logic.init_state(rng)
+    state = (
+        initial_state
+        if initial_state is not None
+        else worker_logic.init_state(rng)
+    )
 
     batch_sharding = None
     if mesh is not None and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1:
@@ -252,6 +267,10 @@ def transform_batched(
     worker_outputs: List[Any] = []
     step_idx = 0
     for batch in data:
+        if skip_batches > 0:
+            skip_batches -= 1
+            step_idx += 1
+            continue
         if batch_sharding is not None:
             batch = jax.tree.map(
                 lambda x: jax.device_put(x, batch_sharding), batch
@@ -259,6 +278,8 @@ def transform_batched(
         table, state, out = step(table, state, batch)
         if on_step is not None:
             on_step(step_idx, out)
+        if state_callback is not None:
+            state_callback(step_idx, table, state, out)
         if collect_outputs:
             worker_outputs.append(out)
         step_idx += 1

@@ -71,13 +71,16 @@ def prefetch(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
 
     q: "queue.Queue" = queue.Queue(maxsize=size)
     sentinel = object()
+    failure = object()
 
     def worker():
         try:
             for item in it:
                 q.put(item)
-        finally:
-            q.put(sentinel)
+        except BaseException as e:  # propagate, never swallow (a crashed
+            q.put((failure, e))     # stream must not look like a clean end)
+            return
+        q.put(sentinel)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
@@ -85,6 +88,8 @@ def prefetch(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
         item = q.get()
         if item is sentinel:
             return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is failure:
+            raise item[1]
         yield item
 
 

@@ -37,13 +37,15 @@ class StepMetrics:
     def step_start(self) -> None:
         self._t_last = time.perf_counter()
 
-    def step_end(self) -> None:
+    def step_end(self, events: Optional[int] = None) -> None:
+        """``events`` overrides the per-step event count (e.g. a padded
+        final batch contributes only its masked-in rows)."""
         assert self._t_last is not None, "step_start() not called"
         self._durations.append(time.perf_counter() - self._t_last)
         if len(self._durations) > self.window:
             self._durations.pop(0)
         self.total_steps += 1
-        self.total_events += self.events_per_step
+        self.total_events += self.events_per_step if events is None else events
 
     # -- reporting --------------------------------------------------------
     def updates_per_sec(self) -> float:

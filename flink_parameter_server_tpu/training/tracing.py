@@ -1,0 +1,70 @@
+"""Tracing / profiling hooks.
+
+Reference parity (SURVEY.md §5 "Tracing / profiling"): the reference
+inherits Flink's web-UI operator metrics; nothing in-repo.  The rebuild's
+equivalents are the JAX profiler (Perfetto/XPlane traces of the jitted
+step, DMA and collective timelines) plus named scopes so pull/compute/push
+phases are attributable inside one fused step.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a JAX profiler trace (view in Perfetto / TensorBoard).
+
+    Wrap a handful of steady-state steps, not the whole run — the first
+    call inside includes compilation."""
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def scope(name: str):
+    """Named scope for phase attribution inside a jitted step: shows up
+    as an annotation on the trace timeline.
+
+    Usage::
+
+        with tracing.scope("pull"):
+            pulled = store.pull(ids)
+    """
+    return jax.named_scope(name)
+
+
+def annotate_step(fn, name: str = "ps_step"):
+    """Wrap a step function so its whole body is one named scope."""
+
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(name):
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+def device_memory_stats() -> dict:
+    """Best-effort per-device memory stats (HBM live bytes)."""
+    out = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except (AttributeError, jax.errors.JaxRuntimeError):
+            stats = None
+        if stats:
+            out[str(d)] = {
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            }
+    return out
+
+
+__all__ = ["profile_trace", "scope", "annotate_step", "device_memory_stats"]
