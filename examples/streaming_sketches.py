@@ -1,0 +1,69 @@
+"""PS-backed streaming sketches over a token stream.
+
+Mirrors the reference's sketch package (SURVEY.md §2 #10): count-min word
+counts, bloom co-occurrence similarity, tug-of-war F2, time decay.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from flink_parameter_server_tpu.core.transform import transform_batched
+from flink_parameter_server_tpu.data.text import (
+    cooccurrence_pairs,
+    synthetic_corpus,
+)
+from flink_parameter_server_tpu.models.sketches import (
+    BloomCooccurrence,
+    CountMinConfig,
+    CountMinSketch,
+    TugOfWarConfig,
+    TugOfWarSketch,
+    decay,
+)
+
+
+def key_batches(keys, batch=1024):
+    for s in range(0, len(keys) - batch + 1, batch):
+        yield {"key": keys[s : s + batch], "mask": np.ones(batch, bool)}
+
+
+def main():
+    vocab = 400
+    tokens = synthetic_corpus(vocab, 100_000, num_topics=8,
+                              topic_stickiness=0.995, seed=3)
+
+    # word counts
+    cms = CountMinSketch(CountMinConfig(width=8192, depth=4, seed=0))
+    words = transform_batched(key_batches(tokens), cms, cms.make_store(),
+                              collect_outputs=False)
+    true = np.bincount(tokens, minlength=vocab)
+    hot = np.argsort(true)[-3:]
+    est = np.asarray(cms.query(words.store, jnp.asarray(hot, jnp.int32)))
+    print("count-min hottest words:", dict(zip(hot.tolist(), est.tolist())),
+          "true:", true[hot].tolist())
+
+    # co-occurrence similarity
+    bloom = BloomCooccurrence(CountMinConfig(width=1 << 15, depth=4, seed=1))
+    pairs = transform_batched(cooccurrence_pairs(tokens, window=2), bloom,
+                              bloom.make_store(), collect_outputs=False)
+    wpt = vocab // 8
+    a = jnp.asarray([0, 0])
+    b = jnp.asarray([1, wpt])  # same-topic vs cross-topic neighbour
+    sims = bloom.similarity(pairs.store, words.store, cms, a, b)
+    print(f"similarity(word0, word1 same-topic)={float(sims[0]):.3f}  "
+          f"(word0, word{wpt} cross-topic)={float(sims[1]):.3f}")
+
+    # F2 second moment
+    tow = TugOfWarSketch(TugOfWarConfig(groups=8, per_group=32, seed=2))
+    f2 = transform_batched(key_batches(tokens), tow, tow.make_store(),
+                           collect_outputs=False)
+    print(f"F2 estimate {float(tow.estimate_f2(f2.store)):.3g} "
+          f"true {float((true.astype(np.float64) ** 2).sum()):.3g}")
+
+    # time-aware decay tick
+    decayed = decay(words.store, 0.5)
+    print("after decay(0.5), hottest estimate:",
+          float(cms.query(decayed, jnp.asarray([int(hot[-1])]))[0]))
+
+
+if __name__ == "__main__":
+    main()

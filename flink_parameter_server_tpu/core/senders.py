@@ -1,0 +1,74 @@
+"""Combination (batching) senders for the event backend.
+
+Reference parity (SURVEY.md §2 #6): the reference's pluggable
+client/server senders — "simple" 1:1 variants plus *combination* variants
+that buffer messages and flush on a count and/or timer trigger — exist to
+amortise Flink's per-message serialization/network cost.
+
+In the compiled TPU backend the microbatch itself is the combination
+buffer (count trigger ≡ batch size; see ops/dedup.py), so this module only
+serves the host event backend: it reproduces the observable semantics of
+message batching (bursty delivery, reordering across the flush boundary)
+for migration tests.  The "timer" is the event loop's logical clock (one
+tick per delivered event) — deterministic, unlike the reference's
+wall-clock timers (SURVEY.md §4's ordering caveat becomes testable).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class SenderPolicy:
+    """Flush policy for a buffering sender.
+
+    count: flush when this many messages are buffered (1 = simple sender,
+    i.e. the reference's non-combination variant).
+    interval: also flush every `interval` logical ticks of the event loop
+    (None = count-only).
+    """
+
+    count: int = 1
+    interval: Optional[int] = None
+
+    def __post_init__(self):
+        assert self.count >= 1
+        assert self.interval is None or self.interval >= 1
+
+
+SIMPLE = SenderPolicy(count=1)
+
+
+class BufferingSender:
+    """Accumulates outgoing messages; ``poll``/``force`` return what to
+    deliver now.  Used for both directions (client→PS and PS→worker)."""
+
+    def __init__(self, policy: SenderPolicy):
+        self.policy = policy
+        self.buffer: List = []
+        self.last_flush_tick = 0
+
+    def offer(self, message, tick: int) -> List:
+        self.buffer.append(message)
+        if len(self.buffer) >= self.policy.count:
+            return self.flush(tick)
+        return []
+
+    def poll(self, tick: int) -> List:
+        """Timer check: flush if the interval elapsed."""
+        if (
+            self.policy.interval is not None
+            and self.buffer
+            and tick - self.last_flush_tick >= self.policy.interval
+        ):
+            return self.flush(tick)
+        return []
+
+    def flush(self, tick: int) -> List:
+        out, self.buffer = self.buffer, []
+        self.last_flush_tick = tick
+        return out
+
+
+__all__ = ["SenderPolicy", "BufferingSender", "SIMPLE"]

@@ -76,22 +76,25 @@ class _LocalClient(ParameterServerClient):
         self._widx = worker_idx
 
     def pull(self, param_id: int) -> None:
-        self._rt.events.append(("w2ps", WorkerToPS(self._widx, Pull(param_id))))
+        self._rt.send_w2ps(self._widx, WorkerToPS(self._widx, Pull(param_id)))
 
     def push(self, param_id: int, delta) -> None:
-        self._rt.events.append(("w2ps", WorkerToPS(self._widx, Push(param_id, delta))))
+        self._rt.send_w2ps(
+            self._widx, WorkerToPS(self._widx, Push(param_id, delta))
+        )
 
     def output(self, w_out) -> None:
         self._rt.worker_outputs.append(w_out)
 
 
 class _LocalPSIface(ParameterServer):
-    def __init__(self, runtime: "_LocalRuntime"):
+    def __init__(self, runtime: "_LocalRuntime", server_idx: int):
         self._rt = runtime
+        self._sidx = server_idx
 
     def answer_pull(self, param_id: int, value, worker_idx: int) -> None:
-        self._rt.events.append(
-            ("ps2w", PSToWorker(worker_idx, PullAnswer(param_id, value)))
+        self._rt.send_ps2w(
+            self._sidx, PSToWorker(worker_idx, PullAnswer(param_id, value))
         )
 
     def output(self, ps_out) -> None:
@@ -113,7 +116,11 @@ class _LocalRuntime:
         ps_logics: List[ParameterServerLogic],
         partitioner: Optional[Callable[[Any, int], int]],
         input_window: int,
+        client_sender: Optional["SenderPolicy"] = None,
+        ps_sender: Optional["SenderPolicy"] = None,
     ):
+        from .senders import SIMPLE, BufferingSender
+
         self.workers = worker_logics
         self.servers = ps_logics
         self.partitioner = partitioner
@@ -121,8 +128,54 @@ class _LocalRuntime:
         self.events: collections.deque = collections.deque()
         self.worker_outputs: List[Any] = []
         self.server_outputs: List[Any] = []
-        self.ps_iface = _LocalPSIface(self)
+        self.ps_ifaces = [
+            _LocalPSIface(self, s) for s in range(len(self.servers))
+        ]
         self.clients = [_LocalClient(self, i) for i in range(len(self.workers))]
+        self.tick = 0
+        self.client_senders = [
+            BufferingSender(client_sender or SIMPLE) for _ in self.workers
+        ]
+        self.ps_senders = [
+            BufferingSender(ps_sender or SIMPLE) for _ in self.servers
+        ]
+        # only interval-triggered senders ever flush from poll(); the
+        # default SIMPLE config leaves this empty (zero per-event cost)
+        self._interval_senders = [
+            ("w2ps", s)
+            for s in self.client_senders
+            if s.policy.interval is not None
+        ] + [
+            ("ps2w", s)
+            for s in self.ps_senders
+            if s.policy.interval is not None
+        ]
+
+    # -- sender plumbing (the combination-sender layer, SURVEY.md §2 #6) --
+    def send_w2ps(self, worker_idx: int, msg: WorkerToPS) -> None:
+        for m in self.client_senders[worker_idx].offer(msg, self.tick):
+            self.events.append(("w2ps", m))
+
+    def send_ps2w(self, server_idx: int, msg: PSToWorker) -> None:
+        for m in self.ps_senders[server_idx].offer(msg, self.tick):
+            self.events.append(("ps2w", m))
+
+    def _poll_senders(self) -> None:
+        for tag, s in self._interval_senders:
+            for m in s.poll(self.tick):
+                self.events.append((tag, m))
+
+    def _force_flush_senders(self) -> bool:
+        flushed = False
+        for s in self.client_senders:
+            for m in s.flush(self.tick):
+                self.events.append(("w2ps", m))
+                flushed = True
+        for s in self.ps_senders:
+            for m in s.flush(self.tick):
+                self.events.append(("ps2w", m))
+                flushed = True
+        return flushed
 
     def _route_server(self, param_id: int) -> int:
         # The reference's partitionCustom(hash(paramId) % psParallelism).
@@ -150,9 +203,15 @@ class _LocalRuntime:
                 in_window += 1
             if not self.events:
                 if exhausted:
+                    # input done and queue drained: force any buffered
+                    # combination-sender messages out before concluding
+                    # (the reference's timeout-flush, made explicit)
+                    if self._force_flush_senders():
+                        continue
                     break
                 continue
             ev = self.events.popleft()
+            self.tick += 1
             if ev[0] == "input":
                 _, widx, record = ev
                 in_window -= 1
@@ -162,11 +221,15 @@ class _LocalRuntime:
                 sidx = self._route_server(msg.message.param_id)
                 if isinstance(msg.message, Pull):
                     self.servers[sidx].on_pull_recv(
-                        msg.message.param_id, msg.worker_partition_index, self.ps_iface
+                        msg.message.param_id,
+                        msg.worker_partition_index,
+                        self.ps_ifaces[sidx],
                     )
                 else:
                     self.servers[sidx].on_push_recv(
-                        msg.message.param_id, msg.message.delta, self.ps_iface
+                        msg.message.param_id,
+                        msg.message.delta,
+                        self.ps_ifaces[sidx],
                     )
             else:  # ps2w
                 msg2: PSToWorker = ev[1]
@@ -175,13 +238,14 @@ class _LocalRuntime:
                     msg2.answer.value,
                     self.clients[msg2.worker_partition_index],
                 )
+            self._poll_senders()
         # Drain: input exhausted and all in-flight messages delivered →
         # fire close hooks (the reference's iterationWaitTime-timeout moment,
         # made explicit).
         for w in self.workers:
             w.close()
-        for s in self.servers:
-            s.close(self.ps_iface)
+        for sidx, s in enumerate(self.servers):
+            s.close(self.ps_ifaces[sidx])
 
 
 def _instances(factory_or_instance, n: int, what: str) -> List[Any]:
@@ -324,6 +388,8 @@ def transform(
     iteration_wait_time: Optional[float] = None,  # accepted for parity; unused
     partitioner: Optional[Callable[[Any, int], int]] = None,
     input_window: Optional[int] = None,
+    client_sender=None,  # SenderPolicy: client→PS combination batching
+    ps_sender=None,  # SenderPolicy: PS→worker combination batching
     **batched_kwargs,
 ) -> TransformResult:
     """Wire ``data`` + worker logic + server logic into a PS job.
@@ -339,7 +405,9 @@ def transform(
 
     ``iteration_wait_time`` is accepted for signature parity with the
     reference but ignored: termination is explicit (input exhaustion), not a
-    silence timeout.
+    silence timeout.  ``client_sender``/``ps_sender`` (combination
+    batching) apply to the event backend only — on the batched TPU path
+    the microbatch itself is the combination buffer, so they are ignored.
     """
     if isinstance(worker_logic, BatchedWorkerLogic):
         if not isinstance(ps_logic, ShardedParamStore):
@@ -362,6 +430,8 @@ def transform(
         servers,
         partitioner,
         input_window if input_window is not None else worker_parallelism,
+        client_sender=client_sender,
+        ps_sender=ps_sender,
     )
     runtime.run(data)
     return TransformResult(
