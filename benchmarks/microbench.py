@@ -1,0 +1,116 @@
+"""Micro-benchmarks for the framework's hot ops.
+
+Not the driver-facing bench (that's /bench.py — one JSON line); this
+script times individual components for tuning, on whatever backend is
+alive:
+
+    python benchmarks/microbench.py [scatter|topk|ring|mf] ...
+
+Each section prints `name value unit` lines.
+"""
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+
+def _timeit(fn, *args, warmup=2, iters=10):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_scatter(capacity=131_072, dim=64, batch=16_384, zipf=1.2):
+    """XLA scatter-add vs the Pallas sorted-run kernel under skew."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.ops.pallas_scatter import scatter_add
+
+    rng = np.random.default_rng(0)
+    table = jnp.zeros((capacity, dim), jnp.float32)
+    ids = jnp.asarray(((rng.zipf(zipf, batch) - 1) % capacity).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (batch, dim)).astype(np.float32))
+
+    xla = jax.jit(lambda t, i, d: t.at[i].add(d))
+    t_xla = _timeit(xla, table, ids, deltas)
+    print(f"scatter_xla {t_xla*1e3:.3f} ms/op")
+
+    if jax.default_backend() == "tpu":
+        pl = jax.jit(lambda t, i, d: scatter_add(t, i, d, interpret=False))
+        t_pl = _timeit(pl, table, ids, deltas)
+        uniq = len(np.unique(np.asarray(ids)))
+        print(f"scatter_pallas {t_pl*1e3:.3f} ms/op (unique ids {uniq}/{batch})")
+    else:
+        print("scatter_pallas skipped (interpret mode is not a perf number)")
+
+
+def bench_topk(rows=131_072, dim=64, batch=64, k=100):
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.ops.topk import dense_topk
+
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(0, 1, (rows, dim)).astype(np.float32))
+    q = jnp.asarray(rng.normal(0, 1, (batch, dim)).astype(np.float32))
+    f = jax.jit(lambda t, q: dense_topk(t, q, k))
+    t = _timeit(f, table, q)
+    print(f"dense_topk {t*1e3:.3f} ms/{batch}q ({rows} items)")
+
+
+def bench_ring(B=4, T=4096, H=8, D=64):
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.parallel.mesh import make_mesh
+    from flink_parameter_server_tpu.parallel.ring_attention import (
+        reference_attention,
+        ring_attention,
+    )
+
+    n = len(jax.devices())
+    sp = min(n, 4)
+    mesh = make_mesh(n // sp, sp, axis_names=("dp", "sp"))
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    ring = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh=mesh))
+    t_ring = _timeit(ring, q, k, v, iters=5)
+    print(f"ring_attention sp={sp} {t_ring*1e3:.2f} ms (B{B} T{T} H{H} D{D})")
+    dense = jax.jit(reference_attention)
+    t_dense = _timeit(dense, q, k, v, iters=5)
+    print(f"dense_attention {t_dense*1e3:.2f} ms")
+
+
+def bench_mf(batch=16_384, dim=64):
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import tpu_updates_per_sec
+
+    rate, p50 = tpu_updates_per_sec(batch=batch, dim=dim)
+    print(f"mf_updates_per_sec {rate:,.0f}  p50 {p50:.3f} ms")
+
+
+SECTIONS = {
+    "scatter": bench_scatter,
+    "topk": bench_topk,
+    "ring": bench_ring,
+    "mf": bench_mf,
+}
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or list(SECTIONS)
+    for name in which:
+        print(f"--- {name} ---")
+        SECTIONS[name]()

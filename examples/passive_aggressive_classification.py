@@ -6,31 +6,11 @@ PA-I updates, prediction stream out.
 """
 import numpy as np
 
+from flink_parameter_server_tpu.data.streams import sparse_feature_batches
 from flink_parameter_server_tpu.models.passive_aggressive import (
     PARule,
     transform_binary,
 )
-
-
-def sparse_batches(X, y, batch, epochs):
-    n, f = X.shape
-    nnz = max((X != 0).sum(1).max(), 1)
-    for _ in range(epochs):
-        for s in range(0, n - batch + 1, batch):
-            rows = range(s, s + batch)
-            ids = np.zeros((batch, nnz), np.int32)
-            vals = np.zeros((batch, nnz), np.float32)
-            fm = np.zeros((batch, nnz), bool)
-            for r, i in enumerate(rows):
-                nz = np.nonzero(X[i])[0]
-                ids[r, : len(nz)] = nz
-                vals[r, : len(nz)] = X[i, nz]
-                fm[r, : len(nz)] = True
-            yield {
-                "ids": ids, "values": vals, "feat_mask": fm,
-                "label": y[list(rows)].astype(np.float32),
-                "mask": np.ones(batch, bool),
-            }
 
 
 def main():
@@ -43,7 +23,7 @@ def main():
 
     losses = []
     res = transform_binary(
-        sparse_batches(X, y, 128, epochs=3),
+        sparse_feature_batches(X, y, 128, epochs=3),
         num_features=F,
         rule=PARule("PA-I", C=1.0),
         on_step=lambda i, o: losses.append(float(np.mean(np.asarray(o["loss"])))),

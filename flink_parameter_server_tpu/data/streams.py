@@ -63,6 +63,107 @@ def microbatches(
             yield batch
 
 
+def partitioned_microbatches(
+    arrays: Dict[str, np.ndarray],
+    batch_size: int,
+    num_partitions: int,
+    *,
+    key: str,
+    capacity: int,
+    epochs: int = 1,
+    shuffle_seed: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Microbatches whose row-blocks are aligned to a dp partitioning of
+    the ``key`` column (``partition = key * num_partitions // capacity``).
+
+    The reference keys its MF input stream by user so each worker owns its
+    users' state locally (SURVEY.md §2 "Data parallelism").  The TPU
+    analogue: when worker state is dp-sharded by blocks of ``capacity //
+    num_partitions`` rows, feeding batches whose i-th row-block only
+    contains partition-i keys makes the state gather/scatter shard-local —
+    zero cross-dp traffic for worker state.
+
+    Each step emits ``batch_size`` rows = ``num_partitions`` equal blocks
+    (padded + masked per block as partitions run dry); iteration ends when
+    every partition is exhausted.
+    """
+    assert batch_size % num_partitions == 0, (batch_size, num_partitions)
+    per = batch_size // num_partitions
+    n = len(arrays[key])
+    part_of = (
+        arrays[key].astype(np.int64) * num_partitions // capacity
+    ).clip(0, num_partitions - 1)
+    rng = np.random.default_rng(shuffle_seed) if shuffle_seed is not None else None
+    for _ in range(epochs):
+        part_indices = []
+        for p in range(num_partitions):
+            idx = np.nonzero(part_of == p)[0]
+            if rng is not None:
+                idx = rng.permutation(idx)
+            part_indices.append(idx)
+        cursors = [0] * num_partitions
+        while any(c < len(part_indices[p]) for p, c in enumerate(cursors)):
+            blocks = {k: [] for k in arrays}
+            mask_blocks = []
+            for p in range(num_partitions):
+                idx = part_indices[p][cursors[p] : cursors[p] + per]
+                cursors[p] += per
+                pad = per - len(idx)
+                for k, v in arrays.items():
+                    col = v[idx]
+                    if pad:
+                        col = np.concatenate(
+                            [col, np.zeros((pad,) + v.shape[1:], v.dtype)]
+                        )
+                    blocks[k].append(col)
+                mask_blocks.append(np.arange(per) < len(idx))
+            batch = {k: np.concatenate(v) for k, v in blocks.items()}
+            batch["mask"] = np.concatenate(mask_blocks)
+            yield batch
+
+
+def sparse_feature_batches(
+    X: np.ndarray,
+    y: np.ndarray,
+    batch_size: int,
+    *,
+    epochs: int = 1,
+    shuffle_seed: Optional[int] = None,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Densify a sparse (N, F) example matrix into the padded sparse batch
+    contract consumed by the PA and FM logics: ``ids``/``values``/
+    ``feat_mask`` (B, K) with K = max nonzeros, plus ``label``/``mask``.
+
+    The multi-pull pattern (SURVEY.md §3.4): only present feature ids are
+    pulled, padding lanes masked out.
+    """
+    n, _f = X.shape
+    nnz_max = max(int((X != 0).sum(1).max()), 1)
+    rng = np.random.default_rng(shuffle_seed) if shuffle_seed is not None else None
+    for _ in range(epochs):
+        order = rng.permutation(n) if rng is not None else np.arange(n)
+        for s in range(0, n, batch_size):
+            idx = order[s : s + batch_size]
+            m = len(idx)
+            ids = np.zeros((batch_size, nnz_max), np.int32)
+            vals = np.zeros((batch_size, nnz_max), np.float32)
+            fm = np.zeros((batch_size, nnz_max), bool)
+            for r, i in enumerate(idx):
+                nz = np.nonzero(X[i])[0]
+                ids[r, : len(nz)] = nz
+                vals[r, : len(nz)] = X[i, nz]
+                fm[r, : len(nz)] = True
+            labels = np.zeros(batch_size, np.float32)
+            labels[:m] = y[idx]
+            yield {
+                "ids": ids,
+                "values": vals,
+                "feat_mask": fm,
+                "label": labels,
+                "mask": np.arange(batch_size) < m,
+            }
+
+
 def prefetch(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
     """Background-thread prefetch of host batches (keeps the device fed
     while the host prepares the next microbatch)."""
@@ -93,4 +194,10 @@ def prefetch(it: Iterator[Any], size: int = 2) -> Iterator[Any]:
         yield item
 
 
-__all__ = ["from_collection", "microbatches", "prefetch"]
+__all__ = [
+    "from_collection",
+    "microbatches",
+    "partitioned_microbatches",
+    "sparse_feature_batches",
+    "prefetch",
+]

@@ -1,0 +1,120 @@
+"""Multi-host (multi-process) scale-out.
+
+Reference parity: the reference scales out by adding Flink TaskManagers —
+worker/server subtasks spread across JVMs, Netty carries the messages
+(SURVEY.md §2 "Distributed communication backend").  The TPU equivalent is
+JAX multi-process: one Python process per host, ``jax.distributed``
+coordination, and *the same named-axis programs* — `Mesh` simply spans all
+hosts' devices and XLA routes collectives over ICI within a slice and DCN
+between slices.  Nothing else in this framework changes: every
+`shard_map`/`pjit` path already addresses devices by mesh axis, not by
+host.
+
+Axis-layout rule (the scaling-book recipe): put the *ps* (parameter) axis
+and any *tp/sp* axes INSIDE a slice so pull/push/ring collectives ride
+ICI; put *dp* across slices so only gradient/delta aggregation crosses
+DCN.  ``make_multihost_mesh`` encodes that default.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+_initialized = False
+
+# Env vars whose presence signals a coordinated multi-process launch.
+_COORD_ENV_HINTS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "CLOUD_TPU_TASK_ID",
+    "TPU_WORKER_ID",
+)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise JAX multi-process mode (idempotent); returns True if
+    distributed init ran.
+
+    MUST be called before any other JAX API touches a backend
+    (``jax.devices()``, the first jit, …) — ``jax.distributed.initialize``
+    rejects already-initialised processes.  With explicit arguments, init
+    always runs (errors propagate).  With no arguments, init runs only
+    when the environment signals a coordinated launch (coordinator env
+    vars / TPU-pod metadata vars); a plain single-process run is a no-op,
+    and crucially this check touches only ``os.environ``, never a JAX
+    backend."""
+    global _initialized
+    if _initialized:
+        return True
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    if not explicit and not any(os.environ.get(k) for k in _COORD_ENV_HINTS):
+        return False  # single process, nothing to coordinate
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def make_multihost_mesh(
+    *,
+    dp: Optional[int] = None,
+    ps: int = 1,
+    axis_names: Tuple[str, str] = ("dp", "ps"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Global mesh over every process's devices with the DCN/ICI-aware
+    layout: the trailing (``ps``) axis is laid out within hosts (ICI),
+    the leading (``dp``) axis across hosts (DCN-crossing is amortised
+    delta aggregation, not per-pull traffic).
+    """
+    explicit_devices = devices is not None
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        assert n % ps == 0, (n, ps)
+        dp = n // ps
+    assert dp * ps == n, f"dp({dp}) * ps({ps}) != global device count ({n})"
+    if not explicit_devices:
+        # jax.devices() ordering groups by process, so row-major
+        # (dp, ps) keeps a ps row within one host iff ps divides the
+        # per-host device count.
+        per_host = jax.local_device_count()
+        assert per_host % ps == 0 or per_host == n, (
+            f"ps axis ({ps}) must divide the per-host device count "
+            f"({per_host}) so parameter-shard rows stay inside one slice "
+            f"and pulls ride ICI, not DCN"
+        )
+    arr = np.array(devices).reshape(dp, ps)
+    return Mesh(arr, axis_names)
+
+
+def process_local_batch_slice(global_batch: int) -> slice:
+    """Which rows of a global batch this process should load — the data
+    pipeline runs per host; each host feeds only its devices' shard
+    (the ingestion edge stays host-local, like the reference's per-TM
+    source splits)."""
+    p = jax.process_index()
+    n = jax.process_count()
+    per = global_batch // n
+    assert per * n == global_batch, (global_batch, n)
+    return slice(p * per, (p + 1) * per)
+
+
+__all__ = ["initialize", "make_multihost_mesh", "process_local_batch_slice"]

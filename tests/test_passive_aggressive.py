@@ -12,34 +12,12 @@ from flink_parameter_server_tpu.models.passive_aggressive import (
 )
 
 
+from flink_parameter_server_tpu.data.streams import sparse_feature_batches
+
+
 def _sparse_batches(X, y, batch_size, epochs=1, seed=0):
-    """Dense (N,F) -> padded sparse microbatches."""
-    rng = np.random.default_rng(seed)
-    n, f = X.shape
-    nnz_max = max((X != 0).sum(1).max(), 1)
-    for _ in range(epochs):
-        for s in range(0, n, batch_size):
-            idx = np.arange(s, min(s + batch_size, n))
-            if len(idx) < batch_size:
-                idx = np.concatenate([idx, np.zeros(batch_size - len(idx), int)])
-                mask = np.arange(batch_size) < (n - s)
-            else:
-                mask = np.ones(batch_size, bool)
-            ids = np.zeros((batch_size, nnz_max), np.int32)
-            vals = np.zeros((batch_size, nnz_max), np.float32)
-            fm = np.zeros((batch_size, nnz_max), bool)
-            for r, i in enumerate(idx):
-                nz = np.nonzero(X[i])[0]
-                ids[r, : len(nz)] = nz
-                vals[r, : len(nz)] = X[i, nz]
-                fm[r, : len(nz)] = True
-            yield {
-                "ids": ids,
-                "values": vals,
-                "feat_mask": fm,
-                "label": y[idx].astype(np.float32),
-                "mask": mask,
-            }
+    """Shared densify-to-sparse-batch helper (data.streams)."""
+    return sparse_feature_batches(X, y, batch_size, epochs=epochs)
 
 
 @pytest.fixture(scope="module")
