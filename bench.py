@@ -77,6 +77,7 @@ def tpu_updates_per_sec(
     batch=16_384,
     warmup_steps=3,
     bench_steps=30,
+    dtype=None,
 ):
     import jax
     import jax.numpy as jnp
@@ -89,9 +90,25 @@ def tpu_updates_per_sec(
     )
     from flink_parameter_server_tpu.utils.initializers import normal_factor
 
-    logic = OnlineMatrixFactorization(num_users, dim, updater=SGDUpdater(0.05))
+    if dtype is None:
+        # bfloat16 is the TPU-native table dtype (halves HBM gather/
+        # scatter bytes) but is *emulated* (≈10× slower) on the CPU
+        # backend — default by platform; FPS_BENCH_DTYPE overrides.
+        default = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+        name = os.environ.get("FPS_BENCH_DTYPE", default)
+        valid = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+        if name not in valid:
+            raise SystemExit(
+                f"FPS_BENCH_DTYPE={name!r} not supported; use one of "
+                f"{sorted(valid)}"
+            )
+        dtype = valid[name]
+    logic = OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(0.05), dtype=dtype
+    )
     store = ShardedParamStore.create(
-        num_items, (dim,), init_fn=normal_factor(1, (dim,))
+        num_items, (dim,), dtype=dtype,
+        init_fn=normal_factor(1, (dim,), dtype=dtype),
     )
     state = logic.init_state(jax.random.PRNGKey(0))
 
@@ -126,7 +143,7 @@ def tpu_updates_per_sec(
         jax.block_until_ready(table)
         lats.append(time.perf_counter() - t1)
     p50_ms = float(np.percentile(np.array(lats), 50) * 1e3)
-    return updates_per_sec, p50_ms
+    return updates_per_sec, p50_ms, jnp.dtype(dtype).name
 
 
 def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
@@ -163,7 +180,7 @@ def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
-    tpu_rate, p50_ms = tpu_updates_per_sec()
+    tpu_rate, p50_ms, table_dtype = tpu_updates_per_sec()
     cpu_rate = cpu_per_record_baseline()
     metric = "MF-SGD updates/sec/chip (synthetic MovieLens-like, Zipf items)"
     if fallback:
@@ -179,6 +196,7 @@ def main():
                     "pull_push_p50_ms": round(p50_ms, 3),
                     "per_record_baseline_updates_per_sec": round(cpu_rate, 1),
                     "platform": platform,
+                    "table_dtype": table_dtype,
                 },
             }
         )

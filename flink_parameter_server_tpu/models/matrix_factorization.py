@@ -27,6 +27,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.api import WorkerLogic
@@ -164,6 +165,109 @@ def ps_online_mf(
     )
 
 
+def make_locality_mf_step(
+    logic: OnlineMatrixFactorization,
+    spec,
+    mesh: Mesh,
+    *,
+    dp_axis: str = DP_AXIS,
+    ps_axis: str = "ps",
+):
+    """The whole MF step fused into ONE ``shard_map`` over (dp × ps) —
+    the explicit-collective alternative to the jit-auto path.
+
+    Contract: batches must be partition-aligned by user
+    (:func:`..data.streams.partitioned_microbatches` with ``key="user"``,
+    ``capacity=num_users``) and ``num_users`` divisible by the dp size;
+    the user table is then dp-block-sharded and its gather/scatter is
+    purely local.  The only collectives per step are the pull's ``psum``
+    over ``ps`` and one ``all_gather`` of (ids, deltas) over ``dp`` for
+    the push — the reference's entire message plane as two ICI ops
+    (SURVEY.md §2 "TPU-native equivalent").  Out-of-partition users are
+    masked out defensively (a violation of the alignment contract drops
+    those updates rather than corrupting other shards' rows).
+
+    Use: ``step = jax.jit(make_locality_mf_step(logic, store.spec, mesh))``
+    then ``table, state, out = step(store.table, state, batch)``.
+    """
+    dp = mesh.shape[dp_axis]
+    ps = mesh.shape[ps_axis]
+    assert spec.padded_capacity % ps == 0, (
+        f"store padded capacity {spec.padded_capacity} not divisible by the "
+        f"mesh ps size {ps} — build the store with this mesh"
+    )
+    rows = spec.padded_capacity // ps
+    assert logic.num_users % dp == 0, (logic.num_users, dp)
+    users_per_shard = logic.num_users // dp
+    updater = logic.updater
+    dtype = logic.dtype
+
+    def body(local_table, local_state, batch):
+        # batches MUST carry a "mask" key (shard_map's in_specs are a
+        # fixed pytree); partitioned_microbatches always emits one
+        users = batch["user"].astype(jnp.int32)
+        items = batch["item"].astype(jnp.int32)
+        ratings = batch["rating"].astype(dtype)
+        mask = batch["mask"]
+
+        # -- pull: each ps shard answers its rows, one psum assembles ----
+        ps_idx = jax.lax.axis_index(ps_axis)
+        lo = ps_idx * rows
+        rel = items - lo
+        hit = (rel >= 0) & (rel < rows)
+        vals = jnp.take(local_table, jnp.clip(rel, 0, rows - 1), axis=0)
+        vals = jnp.where(hit[:, None], vals, jnp.zeros_like(vals))
+        pulled = jax.lax.psum(vals, ps_axis)
+
+        # -- local user state (alignment contract: users live here) ------
+        dp_idx = jax.lax.axis_index(dp_axis)
+        ulo = dp_idx * users_per_shard
+        urel = users - ulo
+        uvalid = (urel >= 0) & (urel < users_per_shard) & mask
+        urel = jnp.clip(urel, 0, users_per_shard - 1)
+        user_vecs = jnp.take(local_state, urel, axis=0)
+
+        user_delta, item_delta, pred = updater.delta(ratings, user_vecs, pulled)
+        um = uvalid[:, None].astype(dtype)
+        local_state = local_state.at[urel].add(user_delta * um)
+
+        # -- push: all_gather the microbatch over dp, local scatter ------
+        # gate on uvalid, not mask: an out-of-partition user's item delta
+        # was computed from the wrong (clipped) user row and must be
+        # dropped, matching the docstring's contract-violation semantics
+        g_items = jax.lax.all_gather(items, dp_axis, tiled=True)
+        g_deltas = jax.lax.all_gather(
+            item_delta * uvalid[:, None].astype(dtype), dp_axis, tiled=True
+        )
+        rel2 = g_items - lo
+        hit2 = (rel2 >= 0) & (rel2 < rows)
+        g_deltas = jnp.where(hit2[:, None], g_deltas, jnp.zeros_like(g_deltas))
+        local_table = local_table.at[jnp.clip(rel2, 0, rows - 1)].add(
+            g_deltas.astype(local_table.dtype)
+        )
+
+        out = {"prediction": pred, "error": (ratings - pred) * uvalid}
+        return local_table, local_state, out
+
+    batch_spec = {
+        "user": P(dp_axis),
+        "item": P(dp_axis),
+        "rating": P(dp_axis),
+        "mask": P(dp_axis),
+    }
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ps_axis, None), P(dp_axis, None), batch_spec),
+        out_specs=(
+            P(ps_axis, None),
+            P(dp_axis, None),
+            {"prediction": P(dp_axis), "error": P(dp_axis)},
+        ),
+        check_vma=False,
+    )
+
+
 class MFWorkerLogic(WorkerLogic):
     """Event-API MF worker — the literal reference programming model
     (SURVEY.md §3.2): buffer the rating, pull the item vector, on answer run
@@ -217,5 +321,6 @@ __all__ = [
     "SGDUpdater",
     "OnlineMatrixFactorization",
     "MFWorkerLogic",
+    "make_locality_mf_step",
     "ps_online_mf",
 ]
