@@ -4,10 +4,11 @@ Mirrors the reference's ``PSOnlineMatrixFactorization`` demo job
 (SURVEY.md §2 #7): stream ratings, keep user factors in worker state and
 item factors on the sharded PS, train with async-style SGD.
 
-Usage:
-    python examples/online_mf_movielens.py [path/to/ratings-file]
+Usage (ParameterTool-style args — utils/config.py):
+    python examples/online_mf_movielens.py [--path ratings-file]
+        [--dim 32] [--lr 0.05] [--epochs 3] [--batch 4096]
 
-Without a path a synthetic Zipf-skewed MovieLens-like stream is used.
+Without ``--path`` a synthetic Zipf-skewed MovieLens-like stream is used.
 Runs on whatever devices are available (CPU mesh works:
 ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8``).
 """
@@ -22,11 +23,16 @@ from flink_parameter_server_tpu.data.movielens import (
 )
 from flink_parameter_server_tpu.data.streams import microbatches
 from flink_parameter_server_tpu.models.matrix_factorization import ps_online_mf
+from flink_parameter_server_tpu.utils.config import Parameters
 
 
 def main():
-    if len(sys.argv) > 1:
-        data = load_movielens(sys.argv[1])
+    params = Parameters.from_env().merged_with(
+        Parameters.from_args(sys.argv[1:])
+    )
+    path = params.get("path")
+    if path:
+        data = load_movielens(path)
     else:
         data = synthetic_ratings(2000, 3000, 200_000, rank=8, seed=0)
     num_users = int(data["user"].max()) + 1
@@ -39,11 +45,16 @@ def main():
         mesh = make_mesh()  # all devices on dp; ps=1
 
     res = ps_online_mf(
-        microbatches(data, 4096, epochs=3, shuffle_seed=0),
+        microbatches(
+            data,
+            params.get_int("batch", 4096),
+            epochs=params.get_int("epochs", 3),
+            shuffle_seed=0,
+        ),
         num_users=num_users,
         num_items=num_items,
-        dim=32,
-        learning_rate=0.05,
+        dim=params.get_int("dim", 32),
+        learning_rate=params.get_float("lr", 0.05),
         mesh=mesh,
         collect_outputs=False,
     )

@@ -1,0 +1,74 @@
+"""Property-based store semantics (hypothesis).
+
+The store is the framework's keyed-state heart; these properties pin the
+reference semantics (SURVEY.md §2 #3) against arbitrary batches:
+push-then-pull observation, permutation invariance of commutative
+updates, and mask/OOB drop behavior.
+"""
+import jax.numpy as jnp
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.utils.initializers import zeros
+
+CAP, DIM = 16, 3
+
+
+def _store():
+    return ShardedParamStore.create(CAP, (DIM,), init_fn=zeros((DIM,)))
+
+
+def _batch(pairs):
+    """(ids, deltas): each scalar delta broadcast across the DIM columns."""
+    ids = jnp.asarray([i for i, _ in pairs], jnp.int32)
+    col = np.array([d for _, d in pairs], np.float32)
+    return ids, jnp.asarray(np.tile(col[:, None], (1, DIM)))
+
+
+ids_deltas = st.lists(
+    st.tuples(
+        st.integers(min_value=-3, max_value=CAP + 3),
+        st.floats(min_value=-5, max_value=5, allow_nan=False, width=32),
+    ),
+    min_size=1,
+    max_size=24,
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids_deltas)
+def test_push_matches_sequential_oracle(pairs):
+    ids, deltas = _batch(pairs)
+    out = _store().push(ids, deltas)
+    want = np.zeros((CAP, DIM), np.float32)
+    for i, d in pairs:
+        if 0 <= i < CAP:
+            want[i] += d
+    np.testing.assert_allclose(np.asarray(out.values()), want, atol=1e-4)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids_deltas, st.randoms(use_true_random=False))
+def test_push_order_invariant(pairs, rnd):
+    """Commutative add: any permutation of the batch yields the same
+    table (the async-interleaving tolerance the reference relies on)."""
+    shuffled = list(pairs)
+    rnd.shuffle(shuffled)
+
+    def run(ps):
+        ids, deltas = _batch(ps)
+        return np.asarray(_store().push(ids, deltas).values())
+
+    np.testing.assert_allclose(run(pairs), run(shuffled), atol=1e-4)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ids_deltas)
+def test_pull_after_push_roundtrip(pairs):
+    ids, deltas = _batch(pairs)
+    store = _store().push(ids, deltas)
+    in_range = jnp.clip(ids, 0, CAP - 1)
+    pulled = np.asarray(store.pull(in_range))
+    table = np.asarray(store.values())
+    np.testing.assert_allclose(pulled, table[np.asarray(in_range)], atol=1e-5)
