@@ -149,17 +149,36 @@ def push(
         )
 
     if spec.update == "add":
-        if spec.scatter_impl == "pallas" and spec.num_shards == 1:
-            # The pallas kernel is per-device; under a >1-shard mesh it
-            # would silently unshard the table (full allgather per push),
-            # so sharded stores stay on the XLA scatter until the kernel
-            # is wrapped in shard_map (future round).
-            from ..ops.pallas_scatter import scatter_add as pallas_scatter_add
+        if spec.scatter_impl == "pallas":
+            if spec.num_shards == 1:
+                from ..ops.pallas_scatter import (
+                    scatter_add as pallas_scatter_add,
+                )
 
-            return pallas_scatter_add(
-                table, flat_ids, flat_deltas,
-                None if mask is None else flat_mask,
-            )
+                return pallas_scatter_add(
+                    table, flat_ids, flat_deltas,
+                    None if mask is None else flat_mask,
+                )
+            # Sharded: run the kernel per ps shard under shard_map (the
+            # explicit collective plane).  Requires the flat batch length
+            # to divide the dp size for the all_gather specs; otherwise
+            # fall back to XLA scatter.
+            from ..parallel.collectives import shard_push_add
+
+            mesh = spec.mesh
+            dp_axis = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+            n = flat_ids.shape[0]
+            if dp_axis is None or n % mesh.shape[dp_axis] == 0:
+                return shard_push_add(
+                    table,
+                    flat_ids,
+                    flat_deltas,
+                    flat_mask if mask is not None else None,
+                    mesh=mesh,
+                    ps_axis=spec.ps_axis,
+                    dp_axis=dp_axis,
+                    impl="pallas",
+                )
         return table.at[flat_ids].add(
             flat_deltas.astype(table.dtype), mode="drop"
         )

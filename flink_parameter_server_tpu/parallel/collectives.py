@@ -96,11 +96,16 @@ def shard_push_add(
     mesh: Mesh,
     ps_axis: str = "ps",
     dp_axis: Optional[str] = "dp",
+    impl: str = "xla",
 ) -> Array:
     """Sharded scatter-add: each ``ps`` shard folds in only the rows it
     owns.  When a ``dp`` axis exists, each worker's deltas are first
     all-gathered over ``dp`` (the worker→server "shuffle", now one ICI
     collective) and then locally scatter-added.
+
+    ``impl="pallas"``: each shard's local scatter runs the sorted-run
+    duplicate-compressing kernel (:mod:`..ops.pallas_scatter`) — one HBM
+    read-modify-write per unique local row under Zipf-hot ids.
     """
     value_rank = table.ndim - 1
     vspec = (None,) * value_rank
@@ -122,6 +127,17 @@ def shard_push_add(
         rel = local_ids.reshape(-1) - lo
         hit = (rel >= 0) & (rel < rows)
         hit = hit & local_mask.reshape(-1)
+        if impl == "pallas":
+            # the public wrapper owns the lane prep (mask→zero-delta,
+            # sort, sentinel handling) — don't duplicate it here
+            from ..ops.pallas_scatter import scatter_add as pallas_scatter_add
+
+            return pallas_scatter_add(
+                local_table,
+                rel,
+                local_deltas.reshape((-1,) + local_table.shape[1:]),
+                hit,
+            )
         rel = jnp.clip(rel, 0, rows - 1)
         d = local_deltas.reshape((-1,) + local_table.shape[1:])
         d = jnp.where(

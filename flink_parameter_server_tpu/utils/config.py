@@ -29,7 +29,9 @@ class Parameters:
             arg = args[i]
             if not arg.startswith("--"):
                 raise ValueError(f"expected --key, got {arg!r}")
-            key = arg[2:]
+            # --use_ring and --use-ring are the same key (and match the
+            # FPS_USE_RING env spelling)
+            key = arg[2:].replace("_", "-")
             if "=" in key:
                 key, _, val = key.partition("=")
                 values[key] = val

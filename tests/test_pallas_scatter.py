@@ -74,3 +74,41 @@ def test_store_pallas_impl_matches_xla():
     np.testing.assert_allclose(
         np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-4
     )
+
+
+def test_shard_push_pallas_impl_matches_xla(mesh):
+    """The pallas kernel under shard_map (per-ps-shard local scatter)
+    must match the XLA impl on a dp x ps mesh."""
+    import jax
+    from flink_parameter_server_tpu.parallel.collectives import shard_push_add
+
+    rng = np.random.default_rng(0)
+    table = jnp.zeros((64, 4), jnp.float32)
+    ids = jnp.asarray(((rng.zipf(1.3, 48) - 1) % 64).reshape(2, 24).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (2, 24, 4)).astype(np.float32))
+    mask = jnp.asarray(rng.random((2, 24)) > 0.1)
+
+    a = shard_push_add(table, ids, deltas, mask, mesh=mesh, impl="xla")
+    b = shard_push_add(table, ids, deltas, mask, mesh=mesh, impl="pallas")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
+
+
+def test_store_pallas_impl_sharded_mesh(mesh):
+    """scatter_impl='pallas' on a sharded store routes through the
+    shard_map kernel and matches XLA, preserving the table sharding."""
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.utils.initializers import zeros
+
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(((rng.zipf(1.3, 64) - 1) % 40).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (64, 4)).astype(np.float32))
+    a = ShardedParamStore.create(
+        40, (4,), init_fn=zeros((4,)), mesh=mesh
+    ).push(ids, deltas)
+    b = ShardedParamStore.create(
+        40, (4,), init_fn=zeros((4,)), mesh=mesh, scatter_impl="pallas"
+    ).push(ids, deltas)
+    np.testing.assert_allclose(
+        np.asarray(a.values()), np.asarray(b.values()), rtol=1e-5, atol=1e-5
+    )
+    assert "ps" in str(b.table.sharding.spec)
