@@ -164,16 +164,23 @@ def push(
             # to divide the dp size for the all_gather specs; otherwise
             # fall back to XLA scatter.
             from ..parallel.collectives import shard_push_add
+            from ..parallel.mesh import DP_AXIS
 
             mesh = spec.mesh
-            dp_axis = "dp" if "dp" in mesh.axis_names and mesh.shape["dp"] > 1 else None
+            dp_axis = (
+                DP_AXIS
+                if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
+                else None
+            )
             n = flat_ids.shape[0]
             if dp_axis is None or n % mesh.shape[dp_axis] == 0:
+                # mask=None: masked lanes' deltas were zeroed above, so a
+                # no-op under add — skip the extra mask all_gather
                 return shard_push_add(
                     table,
                     flat_ids,
                     flat_deltas,
-                    flat_mask if mask is not None else None,
+                    None,
                     mesh=mesh,
                     ps_axis=spec.ps_axis,
                     dp_axis=dp_axis,

@@ -12,6 +12,12 @@ import os
 from typing import Any, Dict, List, Optional, Sequence
 
 
+def _norm(key: str) -> str:
+    """``use_ring`` ≡ ``use-ring`` ≡ ``FPS_USE_RING`` — one key space
+    regardless of spelling or source."""
+    return key.replace("_", "-")
+
+
 class Parameters:
     """Typed key/value lookup over ``--key value`` / ``--key=value`` argv
     pairs and (optionally) prefixed environment variables."""
@@ -29,17 +35,17 @@ class Parameters:
             arg = args[i]
             if not arg.startswith("--"):
                 raise ValueError(f"expected --key, got {arg!r}")
-            # --use_ring and --use-ring are the same key (and match the
-            # FPS_USE_RING env spelling)
-            key = arg[2:].replace("_", "-")
+            key = arg[2:]
             if "=" in key:
+                # split BEFORE normalising so underscores in the value
+                # (paths, run names) are untouched
                 key, _, val = key.partition("=")
-                values[key] = val
+                values[_norm(key)] = val
             elif i + 1 < len(args) and not args[i + 1].startswith("--"):
-                values[key] = args[i + 1]
+                values[_norm(key)] = args[i + 1]
                 i += 1
             else:
-                values[key] = "true"  # bare flag
+                values[_norm(key)] = "true"  # bare flag
             i += 1
         return cls(values)
 
@@ -63,15 +69,16 @@ class Parameters:
 
     # -- lookups ----------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
-        return self._values.get(key, default)
+        return self._values.get(_norm(key), default)
 
     def required(self, key: str) -> str:
-        if key not in self._values:
+        k = _norm(key)
+        if k not in self._values:
             raise KeyError(f"missing required parameter --{key}")
-        return self._values[key]
+        return self._values[k]
 
     def get_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
-        v = self._values.get(key)
+        v = self._values.get(_norm(key))
         if v is None:
             return default
         try:
@@ -82,7 +89,7 @@ class Parameters:
     def get_float(
         self, key: str, default: Optional[float] = None
     ) -> Optional[float]:
-        v = self._values.get(key)
+        v = self._values.get(_norm(key))
         if v is None:
             return default
         try:
@@ -91,7 +98,7 @@ class Parameters:
             raise ValueError(f"--{key}: expected a number, got {v!r}") from e
 
     def get_bool(self, key: str, default: bool = False) -> bool:
-        v = self._values.get(key)
+        v = self._values.get(_norm(key))
         if v is None:
             return default
         return v.strip().lower() in ("1", "true", "yes", "on")
@@ -100,7 +107,7 @@ class Parameters:
         return sorted(self._values)
 
     def __contains__(self, key: str) -> bool:
-        return key in self._values
+        return _norm(key) in self._values
 
     def __repr__(self) -> str:
         return f"Parameters({self._values!r})"

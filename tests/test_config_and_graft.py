@@ -68,3 +68,12 @@ def test_numeric_errors_name_the_key():
     p = Parameters.from_args(["--dim", "abc"])
     with pytest.raises(ValueError, match="--dim"):
         p.get_int("dim")
+
+
+def test_underscore_value_preserved_and_lookup_normalized():
+    p = Parameters.from_args(["--checkpoint_dir=/tmp/my_run_1", "--use_ring"])
+    # values keep their underscores; keys normalise on store AND lookup
+    assert p.get("checkpoint-dir") == "/tmp/my_run_1"
+    assert p.get("checkpoint_dir") == "/tmp/my_run_1"
+    assert p.get_bool("use-ring") and p.get_bool("use_ring")
+    assert "use_ring" in p
