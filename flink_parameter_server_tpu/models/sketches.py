@@ -89,6 +89,22 @@ class CountMinSketch(BatchedWorkerLogic):
         """Point estimate: min over the depth rows' cells."""
         return jnp.min(store.pull(self.cells(keys)), axis=1)
 
+    def top_k(
+        self, store: ShardedParamStore, candidate_ids: Array, k: int
+    ) -> Tuple[Array, Array]:
+        """Heavy hitters among ``candidate_ids``: (estimates, ids) of the
+        k largest estimated counts — the streaming-experiment query the
+        reference's sketches serve (estimate-then-rank), as one batched
+        pull + ``lax.top_k``.  Static (k,) output: padded with -inf/-1
+        when there are fewer candidates (the ops/topk.py convention)."""
+        from ..ops.topk import _pad_topk
+
+        est = self.query(store, candidate_ids)
+        top_est, pos = jax.lax.top_k(est, min(k, candidate_ids.shape[0]))
+        ids = jnp.take(candidate_ids, pos)
+        top_est, ids = _pad_topk(top_est[None], ids[None], k)
+        return top_est[0], ids[0]
+
 
 class BloomCooccurrence(CountMinSketch):
     """Co-occurrence counting for unordered word pairs — the reference's

@@ -44,6 +44,10 @@ class TransformerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    # rematerialise each block in the backward pass (jax.checkpoint):
+    # activation memory per layer drops from O(T·d_ff) to O(T·d_model),
+    # the long-context lever (docs/scaling.md "Memory levers")
+    remat: bool = False
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
@@ -173,7 +177,7 @@ def forward(
     x = constrain(x)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
-    for layer in params["layers"]:
+    def block(x, layer):
         h = _rmsnorm(x, layer["attn_norm"])
         qkv = h @ layer["wqkv"]  # (B, T, 3·d)
         qkv = qkv.reshape(B, T, 3, H, Dh)
@@ -196,7 +200,12 @@ def forward(
 
         h = _rmsnorm(x, layer["mlp_norm"])
         x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
-        x = constrain(x)
+        return constrain(x)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for layer in params["layers"]:
+        x = block(x, layer)
 
     x = _rmsnorm(x, params["final_norm"])
     logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)

@@ -117,3 +117,27 @@ def test_decay_halves_counters():
     np.testing.assert_allclose(
         np.asarray(decayed.values()), np.asarray(res.store.values()) * 0.5
     )
+
+
+def test_count_min_heavy_hitters():
+    rng = np.random.default_rng(5)
+    keys = ((rng.zipf(1.5, 15_000) - 1) % 500).astype(np.int32)
+    sketch = CountMinSketch(CountMinConfig(width=4096, depth=4, seed=5))
+    res = transform_batched(
+        _key_batches(keys), sketch, sketch.make_store(), collect_outputs=False
+    )
+    true = np.bincount(keys, minlength=500)
+    est, ids = sketch.top_k(res.store, jnp.arange(500), k=5)
+    true_top5 = set(np.argsort(true)[-5:].tolist())
+    assert set(np.asarray(ids).tolist()) == true_top5
+
+
+def test_heavy_hitters_pads_to_k():
+    sketch = CountMinSketch(CountMinConfig(width=64, depth=2, seed=6))
+    res = transform_batched(
+        _key_batches(np.zeros(600, np.int32)), sketch, sketch.make_store(),
+        collect_outputs=False,
+    )
+    est, ids = sketch.top_k(res.store, jnp.arange(2), k=5)
+    assert ids.shape == (5,) and est.shape == (5,)
+    assert (np.asarray(ids)[2:] == -1).all()

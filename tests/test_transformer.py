@@ -180,3 +180,28 @@ def test_lm_loss_row_mask():
         )
     )
     assert np.isfinite(masked) and masked != full
+
+
+def test_remat_matches_non_remat_gradients():
+    """jax.checkpoint rematerialisation must not change values or grads."""
+    import dataclasses
+
+    cfg_r = dataclasses.replace(TINY, remat=True)
+    params = init_params(jax.random.PRNGKey(3), TINY)
+    toks = jnp.asarray(
+        np.random.default_rng(2).integers(0, 64, (2, 16)).astype(np.int32)
+    )
+    loss_plain, grads_plain = jax.value_and_grad(
+        lambda p: lm_loss(p, {"tokens": toks}, TINY)
+    )(params)
+    loss_remat, grads_remat = jax.value_and_grad(
+        lambda p: lm_loss(p, {"tokens": toks}, cfg_r)
+    )(params)
+    assert float(loss_plain) == pytest.approx(float(loss_remat), rel=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5
+        ),
+        grads_plain,
+        grads_remat,
+    )
