@@ -20,6 +20,7 @@ import os
 from typing import Any, Iterable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.batched import BatchedWorkerLogic
@@ -29,6 +30,23 @@ from ..data.streams import prefetch as prefetch_iter
 from . import checkpoint as ckpt
 from .metrics import StepMetrics
 from .tracing import profile_trace
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised by the driver's NaN guard (DriverConfig.nan_check_every)."""
+
+
+def _all_finite(*trees) -> jax.Array:
+    """Single fused device-side finiteness reduction over every floating
+    leaf of the given pytrees (one host transfer at the bool() call)."""
+    ok = jnp.asarray(True)
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(
+                leaf.dtype, jnp.floating
+            ):
+                ok = jnp.logical_and(ok, jnp.isfinite(leaf).all())
+    return ok
 
 
 @dataclasses.dataclass
@@ -44,6 +62,12 @@ class DriverConfig:
     profile_steps: tuple = (10, 13)
     prefetch: int = 2
     dump_model: bool = True
+    # Failure detection (SURVEY.md §5): every N steps, verify the step
+    # outputs are finite; on NaN/inf raise TrainingDiverged — with a
+    # checkpoint_dir configured the driver rolls back to the last durable
+    # checkpoint (the crash-recovery path), turning silent divergence
+    # into a recoverable fault.  0 = off.
+    nan_check_every: int = 0
 
 
 class StreamingDriver:
@@ -164,6 +188,20 @@ class StreamingDriver:
             ):
                 trace_ctx["cm"].__exit__(None, None, None)
                 trace_ctx["cm"] = None
+            is_ckpt_step = (
+                cfg.checkpoint_every and global_step % cfg.checkpoint_every == 0
+            )
+            if cfg.nan_check_every and (
+                global_step % cfg.nan_check_every == 0 or is_ckpt_step
+            ):
+                # check table+state too (outputs may carry no floats), as
+                # ONE fused device reduction + a single host transfer;
+                # always check on checkpoint steps so a poisoned table is
+                # never persisted as the "recovery" point
+                if not bool(_all_finite(out, table, state)):
+                    raise TrainingDiverged(
+                        f"non-finite step output/params at step {global_step}"
+                    )
             if cfg.metrics_every and global_step % cfg.metrics_every == 0:
                 self.metrics.emit(self.metrics_sink)
             if cfg.checkpoint_every and global_step % cfg.checkpoint_every == 0:
@@ -211,4 +249,4 @@ class StreamingDriver:
         return result
 
 
-__all__ = ["DriverConfig", "StreamingDriver"]
+__all__ = ["DriverConfig", "StreamingDriver", "TrainingDiverged"]

@@ -162,3 +162,42 @@ def test_driver_usable_after_midrun_crash(tmp_path):
     assert d.step_idx == 5
     assert np.isfinite(np.asarray(d.store.values())).all()
     d.run(_stream(3), fast_forward=False)
+
+
+def test_nan_guard_detects_and_rolls_back(tmp_path):
+    """Failure detection (SURVEY §5): a diverging stream raises
+    TrainingDiverged and the driver rolls back to the last checkpoint."""
+    from flink_parameter_server_tpu.training.driver import TrainingDiverged
+
+    d = _driver(tmp_path, checkpoint_every=5, nan_check_every=1)
+
+    def poisoned():
+        for i, b in enumerate(_stream()):
+            if i >= 7:
+                b = dict(b, rating=b["rating"] * np.nan)
+            yield b
+
+    with pytest.raises(TrainingDiverged, match="step 8"):
+        d.run(poisoned())
+    assert d.step_idx == 5  # rolled back to the durable checkpoint
+    assert np.isfinite(np.asarray(d.store.values())).all()
+
+
+def test_nan_guard_blocks_poisoned_checkpoint(tmp_path):
+    """A NaN landing exactly on a checkpoint step must be caught BEFORE
+    the save (even when the step misses the nan_check_every modulus), so
+    the rollback point is never poisoned."""
+    from flink_parameter_server_tpu.training.driver import TrainingDiverged
+
+    d = _driver(tmp_path, checkpoint_every=5, nan_check_every=7)
+
+    def poisoned():
+        for i, b in enumerate(_stream()):
+            if i == 9:  # global step 10 — a checkpoint step, not a 7-multiple
+                b = dict(b, rating=b["rating"] * np.inf)
+            yield b
+
+    with pytest.raises(TrainingDiverged, match="step 10"):
+        d.run(poisoned())
+    assert d.step_idx == 5
+    assert np.isfinite(np.asarray(d.store.values())).all()
