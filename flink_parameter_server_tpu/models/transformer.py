@@ -51,6 +51,7 @@ class TransformerConfig:
     dp_axis: Optional[str] = "dp"
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
+    pp_axis: Optional[str] = None  # pipeline stages (forward_pipelined)
 
     @property
     def head_dim(self) -> int:
@@ -142,6 +143,45 @@ def _rope(x: Array, positions: Array) -> Array:
     return out.astype(x.dtype)
 
 
+def _apply_block(
+    x: Array,
+    layer: Dict,
+    cfg: TransformerConfig,
+    mesh: Optional[Mesh],
+    constrain=None,
+) -> Array:
+    """One pre-norm residual block (attention + MLP) on (B, T, d).
+
+    ``constrain``: optional activation-sharding anchor applied to the
+    attention-residual output (keeps XLA's propagation from resharding
+    mid-block on dp/sp meshes)."""
+    B, T, _d = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    h = _rmsnorm(x, layer["attn_norm"])
+    qkv = h @ layer["wqkv"]  # (B, T, 3·d)
+    qkv = qkv.reshape(B, T, 3, H, Dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = _rope(q, positions)
+    k = _rope(k, positions)
+    if cfg.use_ring_attention and mesh is not None and cfg.sp_axis:
+        attn = ring_attention(
+            q, k, v,
+            mesh=mesh,
+            sp_axis=cfg.sp_axis,
+            dp_axis=cfg.dp_axis if cfg.dp_axis in mesh.axis_names else None,
+            tp_axis=cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None,
+        )
+    else:
+        attn = reference_attention(q, k, v)
+    attn = attn.reshape(B, T, H * Dh)
+    x = x + attn @ layer["wo"]
+    if constrain is not None:
+        x = constrain(x)
+    h = _rmsnorm(x, layer["mlp_norm"])
+    return x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+
+
 def forward(
     params: Dict,
     tokens: Array,
@@ -178,28 +218,7 @@ def forward(
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
     def block(x, layer):
-        h = _rmsnorm(x, layer["attn_norm"])
-        qkv = h @ layer["wqkv"]  # (B, T, 3·d)
-        qkv = qkv.reshape(B, T, 3, H, Dh)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        q = _rope(q, positions)
-        k = _rope(k, positions)
-        if cfg.use_ring_attention and mesh is not None and cfg.sp_axis:
-            attn = ring_attention(
-                q, k, v,
-                mesh=mesh,
-                sp_axis=cfg.sp_axis,
-                dp_axis=cfg.dp_axis if cfg.dp_axis in mesh.axis_names else None,
-                tp_axis=cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None,
-            )
-        else:
-            attn = reference_attention(q, k, v)
-        attn = attn.reshape(B, T, H * Dh)
-        x = x + attn @ layer["wo"]
-        x = constrain(x)
-
-        h = _rmsnorm(x, layer["mlp_norm"])
-        x = x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+        x = _apply_block(x, layer, cfg, mesh, constrain=constrain)
         return constrain(x)
 
     if cfg.remat:
@@ -210,6 +229,53 @@ def forward(
     x = _rmsnorm(x, params["final_norm"])
     logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
     return logits
+
+
+def forward_pipelined(
+    params: Dict,
+    tokens: Array,
+    cfg: TransformerConfig,
+    *,
+    mesh: Mesh,
+    num_microbatches: int = 4,
+) -> Array:
+    """Causal LM forward with the layer stack pipelined over
+    ``cfg.pp_axis`` (GPipe schedule, :mod:`..parallel.pipeline`):
+    each stage holds ``n_layers / pp`` blocks; microbatches stream
+    through the stage ring.  Embed / final norm / logits run replicated
+    outside the pipeline.  Dense attention inside stages (ring+pp
+    composition is future work)."""
+    from ..parallel.pipeline import pipeline_apply, stack_stage_params
+
+    assert cfg.pp_axis and cfg.pp_axis in mesh.axis_names
+    S = mesh.shape[cfg.pp_axis]
+    B, T = tokens.shape
+    assert T <= cfg.max_seq, f"sequence length {T} > max_seq {cfg.max_seq}"
+
+    x = jnp.take(params["embed"], tokens, axis=0)
+    stage_params = stack_stage_params(params["layers"], S)
+
+    block_cfg = dataclasses.replace(cfg, use_ring_attention=False)
+
+    def stage_fn(stage_local, x_mb):
+        # stage_local leaves: (layers_per_stage, ...) — scan the blocks
+        def step(carry, layer):
+            return _apply_block(carry, layer, block_cfg, None), None
+
+        if cfg.remat:  # the long-context memory lever applies per block
+            step = jax.checkpoint(step)
+        out, _ = jax.lax.scan(step, x_mb, stage_local)
+        return out
+
+    x = pipeline_apply(
+        stage_params, x, stage_fn,
+        mesh=mesh,
+        pp_axis=cfg.pp_axis,
+        dp_axis=cfg.dp_axis,
+        num_microbatches=num_microbatches,
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
 
 
 def lm_loss(params: Dict, batch: Dict[str, Array], cfg: TransformerConfig,
@@ -237,5 +303,6 @@ __all__ = [
     "init_params",
     "param_shardings",
     "forward",
+    "forward_pipelined",
     "lm_loss",
 ]
