@@ -52,6 +52,19 @@ class TransformerConfig:
     tp_axis: Optional[str] = None
     sp_axis: Optional[str] = None
     pp_axis: Optional[str] = None  # pipeline stages (forward_pipelined)
+    # sparse-expert MLPs (models/moe.py): num_experts > 0 replaces every
+    # layer's dense MLP with a top-1 switch MoE, experts sharded over
+    # ep_axis (expert parallelism)
+    num_experts: int = 0
+    ep_axis: Optional[str] = None
+    moe_capacity: int = 0
+
+    def __post_init__(self):
+        if self.num_experts > 0:
+            assert self.moe_capacity > 0, (
+                "num_experts > 0 requires moe_capacity > 0 (capacity 0 "
+                "would silently drop every token)"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -74,9 +87,17 @@ def param_shardings(cfg: TransformerConfig, mesh: Optional[Mesh]) -> Dict:
         "wqkv": _spec(mesh, None, tp),  # column parallel
         "wo": _spec(mesh, tp, None),  # row parallel
         "mlp_norm": _spec(mesh, None),
-        "w_up": _spec(mesh, None, tp),
-        "w_down": _spec(mesh, tp, None),
     }
+    if cfg.num_experts > 0:
+        ep = cfg.ep_axis
+        layer["moe"] = {
+            "w_gate": _spec(mesh, None, None),
+            "w_up": _spec(mesh, ep, None, None),
+            "w_down": _spec(mesh, ep, None, None),
+        }
+    else:
+        layer["w_up"] = _spec(mesh, None, tp)
+        layer["w_down"] = _spec(mesh, tp, None)
     return {
         "embed": _spec(mesh, None, None),
         "final_norm": _spec(mesh, None),
@@ -98,16 +119,25 @@ def init_params(rng: Array, cfg: TransformerConfig, mesh: Optional[Mesh] = None)
     for i in range(cfg.n_layers):
         k = jax.random.fold_in(k_layers, i)
         k1, k2, k3, k4 = jax.random.split(k, 4)
-        layers.append(
-            {
-                "attn_norm": jnp.ones((d,), jnp.float32),
-                "wqkv": dense(k1, (d, 3 * d), d**-0.5),
-                "wo": dense(k2, (d, d), (2 * cfg.n_layers * d) ** -0.5),
-                "mlp_norm": jnp.ones((d,), jnp.float32),
-                "w_up": dense(k3, (d, f), d**-0.5),
-                "w_down": dense(k4, (f, d), (2 * cfg.n_layers * f) ** -0.5),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((d,), jnp.float32),
+            "wqkv": dense(k1, (d, 3 * d), d**-0.5),
+            "wo": dense(k2, (d, d), (2 * cfg.n_layers * d) ** -0.5),
+            "mlp_norm": jnp.ones((d,), jnp.float32),
+        }
+        if cfg.num_experts > 0:
+            from .moe import MoEConfig, init_moe_params
+
+            moe_cfg = MoEConfig(
+                d_model=d, d_ff=f, num_experts=cfg.num_experts,
+                capacity=cfg.moe_capacity, dtype=cfg.dtype,
+            )
+            # mesh=None: placement happens once, via param_shardings below
+            layer["moe"] = init_moe_params(k3, moe_cfg, None)
+        else:
+            layer["w_up"] = dense(k3, (d, f), d**-0.5)
+            layer["w_down"] = dense(k4, (f, d), (2 * cfg.n_layers * f) ** -0.5)
+        layers.append(layer)
     params = {
         # small embed init: with tied output weights a unit-scale embedding
         # makes initial logits (and loss) explode
@@ -179,6 +209,24 @@ def _apply_block(
     if constrain is not None:
         x = constrain(x)
     h = _rmsnorm(x, layer["mlp_norm"])
+    if "moe" in layer:
+        from .moe import MoEConfig, moe_apply, moe_dense
+
+        moe_cfg = MoEConfig(
+            d_model=cfg.d_model, d_ff=cfg.d_ff,
+            num_experts=cfg.num_experts, capacity=cfg.moe_capacity,
+            dtype=cfg.dtype,
+        )
+        flat = h.reshape(B * T, cfg.d_model)
+        if mesh is not None and cfg.ep_axis and cfg.ep_axis in mesh.axis_names:
+            y = moe_apply(
+                layer["moe"], flat, moe_cfg, mesh=mesh,
+                ep_axis=cfg.ep_axis,
+                dp_axis=cfg.dp_axis if cfg.dp_axis in mesh.axis_names else None,
+            )
+        else:
+            y = moe_dense(layer["moe"], flat, moe_cfg)
+        return x + y.reshape(B, T, cfg.d_model)
     return x + jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
 
 
@@ -196,7 +244,6 @@ def forward(
     """
     B, T = tokens.shape
     assert T <= cfg.max_seq, f"sequence length {T} > max_seq {cfg.max_seq}"
-    H, Dh = cfg.n_heads, cfg.head_dim
 
     act_spec = None
     if mesh is not None:
@@ -215,7 +262,6 @@ def forward(
 
     x = jnp.take(params["embed"], tokens, axis=0)
     x = constrain(x)
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
 
     def block(x, layer):
         x = _apply_block(x, layer, cfg, mesh, constrain=constrain)
@@ -253,7 +299,9 @@ def forward_pipelined(
     assert T <= cfg.max_seq, f"sequence length {T} > max_seq {cfg.max_seq}"
 
     x = jnp.take(params["embed"], tokens, axis=0)
-    stage_params = stack_stage_params(params["layers"], S)
+    stage_params = stack_stage_params(
+        params["layers"], S, mesh=mesh, pp_axis=cfg.pp_axis
+    )
 
     block_cfg = dataclasses.replace(cfg, use_ring_attention=False)
 

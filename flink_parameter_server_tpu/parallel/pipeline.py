@@ -128,9 +128,21 @@ def pipeline_apply(
     )(stage_params, x)
 
 
-def stack_stage_params(layer_params_list, num_stages: int):
+def stack_stage_params(
+    layer_params_list,
+    num_stages: int,
+    *,
+    mesh: Mesh | None = None,
+    pp_axis: str = "pp",
+):
     """Group a list of per-layer param pytrees into ``num_stages`` stacked
     stage pytrees: leaves gain leading dims (num_stages, layers_per_stage).
+
+    With ``mesh``, the stacked leaves are placed ``P(pp_axis, ...)`` so
+    steady-state parameter memory is stage-sharded (each chip holds only
+    its layers).  The stacking itself transiently materialises the full
+    stack on the source device — for models too large even for that,
+    build per-stage params directly on their shards (future round).
 
     ``block_fn`` then scans its stage's (layers_per_stage, ...) leaves.
     """
@@ -142,7 +154,15 @@ def stack_stage_params(layer_params_list, num_stages: int):
         stacked = jnp.stack(leaves)  # (n, ...)
         return stacked.reshape((num_stages, per) + stacked.shape[1:])
 
-    return jax.tree.map(stack, *layer_params_list)
+    out = jax.tree.map(stack, *layer_params_list)
+    if mesh is not None:
+        out = jax.tree.map(
+            lambda l: jax.device_put(
+                l, jax.NamedSharding(mesh, P(pp_axis))
+            ),
+            out,
+        )
+    return out
 
 
 __all__ = ["pipeline_apply", "stack_stage_params"]

@@ -69,8 +69,17 @@ def restore(
     """
     ocp = _ocp()
     path = os.path.abspath(path)
+    import warnings
+
     with ocp.PyTreeCheckpointer() as ckptr:
-        payload = ckptr.restore(path)
+        with warnings.catch_warnings():
+            # orbax warns that restoring without target shardings reads the
+            # sharding file — intentional here: elasticity means we restore
+            # to host then re-place onto the *target* spec below.
+            warnings.filterwarnings(
+                "ignore", message="Sharding info not provided"
+            )
+            payload = ckptr.restore(path)
     meta = payload.get("meta", {})
     capacity = int(meta.get("capacity", spec.capacity))
     values = np.asarray(payload["table"])[: min(capacity, spec.capacity)]
