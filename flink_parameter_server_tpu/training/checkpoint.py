@@ -106,9 +106,16 @@ def restore(
 def load_model(path: str, **from_values_kwargs) -> ShardedParamStore:
     """The ``transformWithModelLoad`` analogue from a checkpoint file:
     seed a fresh store from a saved table (SURVEY.md §2 #1)."""
+    import warnings
+
     ocp = _ocp()
     with ocp.PyTreeCheckpointer() as ckptr:
-        payload = ckptr.restore(os.path.abspath(path))
+        with warnings.catch_warnings():
+            # intentional: load to host, re-place via from_values below
+            warnings.filterwarnings(
+                "ignore", message="Sharding info not provided"
+            )
+            payload = ckptr.restore(os.path.abspath(path))
     values = np.asarray(payload["table"])[: payload["meta"]["capacity"]]
     return ShardedParamStore.from_values(
         jax.numpy.asarray(values), **from_values_kwargs
