@@ -326,24 +326,30 @@ def forward_pipelined(
     return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
 
 
-def lm_loss(params: Dict, batch: Dict[str, Array], cfg: TransformerConfig,
-            *, mesh: Optional[Mesh] = None) -> Array:
-    """Next-token cross entropy.  Batch: ``tokens`` (B, T) with targets =
-    tokens shifted left; last position masked."""
-    tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, mesh=mesh)
+def next_token_xent(
+    logits: Array, tokens: Array, row_mask: Optional[Array] = None
+) -> Array:
+    """Next-token cross entropy from logits: targets = tokens shifted
+    left; last position masked; optional (B,) or (B, T) row mask."""
     targets = jnp.concatenate(
         [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
-    if "mask" in batch:
-        row_mask = batch["mask"]
+    if row_mask is not None:
         if row_mask.ndim == 1:  # (B,) row mask from microbatches()
             row_mask = row_mask[:, None]
         mask = mask * row_mask
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def lm_loss(params: Dict, batch: Dict[str, Array], cfg: TransformerConfig,
+            *, mesh: Optional[Mesh] = None) -> Array:
+    """Next-token cross entropy through :func:`forward`."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, mesh=mesh)
+    return next_token_xent(logits, tokens, batch.get("mask"))
 
 
 __all__ = [
