@@ -88,3 +88,12 @@ def test_stream_feeds_training(ratings_file, tmp_path):
         collect_outputs=False,
     )
     assert np.isfinite(np.asarray(res.store.values())).all()
+
+
+def test_parse_crlf_and_no_trailing_newline(tmp_path):
+    """Windows line endings and a file ending without newline parse fine."""
+    p = tmp_path / "crlf.data"
+    p.write_bytes(b"1\t10\t4.0\t0\r\n2\t20\t3.5\t0")  # CRLF + no final \n
+    out = native.load_ratings(str(p), compact_ids=False)
+    np.testing.assert_array_equal(out["user"], [1, 2])
+    np.testing.assert_allclose(out["rating"], [4.0, 3.5])
