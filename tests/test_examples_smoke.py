@@ -1,0 +1,46 @@
+"""Smoke tests for the runnable examples (the reference's L5 apps).
+
+Runs the two fastest examples as real subprocesses — the exact user
+surface — so example bit-rot fails CI.  The rest of the suite exercises
+the same code paths through the API; the long examples are covered by the
+verify workflow rather than per-commit tests.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+def test_passive_aggressive_example():
+    r = _run([os.path.join("examples", "passive_aggressive_classification.py")])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "train accuracy" in r.stdout
+
+
+def test_mf_example_with_args():
+    r = _run(
+        [
+            os.path.join("examples", "online_mf_movielens.py"),
+            "--dim", "8", "--epochs", "1", "--batch", "8192",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "train RMSE" in r.stdout

@@ -131,3 +131,44 @@ def test_transform_with_model_load_simple_overload():
         param_update=lambda c, d: c + d,
     )
     assert dict(res.server_outputs)["a"] == 8
+
+
+def test_make_mf_topk_step_interleaved_queries():
+    """The fused train+serve step answers in-stream queries against the
+    pre-push table — the reference's interleaved query events."""
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.models.topk_recommender import (
+        make_mf_topk_step,
+    )
+    from flink_parameter_server_tpu.ops.topk import dense_topk
+    from flink_parameter_server_tpu.utils.initializers import (
+        ranged_random_factor,
+    )
+
+    logic = OnlineMatrixFactorization(32, 4, updater=SGDUpdater(0.05))
+    store = ShardedParamStore.create(
+        48, (4,), init_fn=ranged_random_factor(1, (4,))
+    )
+    step = jax.jit(make_mf_topk_step(logic, store.spec, k=5))
+    state = logic.init_state(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    batch = {
+        "user": jnp.asarray(rng.integers(0, 32, 64).astype(np.int32)),
+        "item": jnp.asarray(rng.integers(0, 48, 64).astype(np.int32)),
+        "rating": jnp.asarray(rng.normal(0, 1, 64).astype(np.float32)),
+        "mask": jnp.ones(64, bool),
+        "query_user": jnp.asarray([0, 5, 9], jnp.int32),
+    }
+    table2, state2, out = step(store.table, state, batch)
+    assert out["topk_ids"].shape == (3, 5)
+    # queries were served against the PRE-push table with POST-update
+    # user vectors (bounded staleness semantics)
+    q = jnp.take(state2, batch["query_user"], axis=0)
+    want_scores, want_ids = dense_topk(store.table, q, 5, valid_rows=48)
+    np.testing.assert_array_equal(
+        np.asarray(out["topk_ids"]), np.asarray(want_ids)
+    )
