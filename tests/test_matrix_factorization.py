@@ -172,3 +172,6 @@ def test_make_mf_topk_step_interleaved_queries():
     np.testing.assert_array_equal(
         np.asarray(out["topk_ids"]), np.asarray(want_ids)
     )
+    np.testing.assert_allclose(
+        np.asarray(out["topk_scores"]), np.asarray(want_scores), atol=1e-5
+    )
