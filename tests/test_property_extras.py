@@ -1,0 +1,96 @@
+"""Property tests for the hash families and shape/schedule sweeps for the
+collective building blocks (ring attention, pipeline)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from flink_parameter_server_tpu.ops.hashing import (
+    bucket_hash,
+    hash_params,
+    pair_key,
+    permute_ids,
+    sign_hash,
+)
+from flink_parameter_server_tpu.parallel.mesh import make_mesh
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    st.integers(min_value=0, max_value=2**31 - 1),
+    st.integers(min_value=0, max_value=1000),
+    st.sampled_from([64, 1000, 4096]),
+)
+def test_bucket_hash_range_and_determinism(x, seed, m):
+    a, b = hash_params(4, seed)
+    h1 = np.asarray(bucket_hash(jnp.asarray([x]), a, b, m))
+    h2 = np.asarray(bucket_hash(jnp.asarray([x]), a, b, m))
+    assert (h1 == h2).all()
+    assert ((h1 >= 0) & (h1 < m)).all()
+
+
+def test_sign_hash_balanced():
+    a, b = hash_params(8, 3)
+    s = np.asarray(sign_hash(jnp.arange(10_000), a, b))
+    assert set(np.unique(s)) == {-1.0, 1.0}
+    # each hash's mean sign should be near zero
+    assert np.abs(s.mean(axis=0)).max() < 0.05
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.sampled_from([256, 1024, 8192]), st.integers(0, 2**16))
+def test_permute_ids_bijective(capacity, seed):
+    p = np.asarray(permute_ids(jnp.arange(capacity), capacity, seed=seed))
+    assert len(np.unique(p)) == capacity
+
+
+def test_pair_key_symmetric():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 10_000, 500).astype(np.int32))
+    y = jnp.asarray(rng.integers(0, 10_000, 500).astype(np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(pair_key(x, y, 1 << 20)), np.asarray(pair_key(y, x, 1 << 20))
+    )
+
+
+@pytest.mark.parametrize(
+    "B,T,H,D,sp", [(1, 16, 1, 4, 8), (3, 64, 2, 16, 4), (2, 24, 5, 8, 2)]
+)
+def test_ring_attention_shape_sweep(B, T, H, D, sp):
+    from flink_parameter_server_tpu.parallel.ring_attention import (
+        reference_attention,
+        ring_attention,
+    )
+
+    mesh = make_mesh(8 // sp, sp, axis_names=("dp", "sp"))
+    rng = np.random.default_rng(B * T + H)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    got = ring_attention(q, k, v, mesh=mesh, dp_axis=None)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-5)
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (4, 1), (4, 4), (8, 2)])
+def test_pipeline_schedule_sweep(S, M):
+    """pipeline_apply == sequential stage application for any (S, M)."""
+    from flink_parameter_server_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = make_mesh(8 // S, S, axis_names=("dp", "pp"))
+    rng = np.random.default_rng(S * 10 + M)
+    dp = 8 // S
+    B = M * dp * 2
+    x = jnp.asarray(rng.normal(0, 1, (B, 6)).astype(np.float32))
+    stage_w = jnp.asarray(rng.normal(0, 0.5, (S, 6)).astype(np.float32))
+
+    def block(w, xm):
+        return xm * w[0] + jnp.tanh(xm) * 0.1
+
+    got = pipeline_apply(
+        stage_w, x, block, mesh=mesh, num_microbatches=M
+    )
+    want = x
+    for s in range(S):
+        want = block(stage_w[s], want)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
