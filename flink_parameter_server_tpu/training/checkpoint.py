@@ -28,6 +28,18 @@ def _ocp():
     return ocp
 
 
+def _make_payload(store, worker_state, step, extra):
+    return {
+        "table": store.table,
+        "worker_state": worker_state if worker_state is not None else (),
+        "meta": {
+            "step": step,
+            "capacity": store.spec.capacity,
+            **(extra or {}),
+        },
+    }
+
+
 def save(
     path: str,
     store: ShardedParamStore,
@@ -39,17 +51,8 @@ def save(
     """Save (param table, worker state, cursor) atomically under ``path``."""
     ocp = _ocp()
     path = os.path.abspath(path)
-    payload = {
-        "table": store.table,
-        "worker_state": worker_state if worker_state is not None else (),
-        "meta": {
-            "step": step,
-            "capacity": store.spec.capacity,
-            **(extra or {}),
-        },
-    }
     with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, payload, force=True)
+        ckptr.save(path, _make_payload(store, worker_state, step, extra), force=True)
 
 
 def restore(
@@ -80,6 +83,13 @@ def restore(
                 "ignore", message="Sharding info not provided"
             )
             payload = ckptr.restore(path)
+    return _payload_to_state(payload, spec, worker_state_shardings)
+
+
+def _payload_to_state(
+    payload, spec: StoreSpec, worker_state_shardings: Any = None
+) -> Tuple[ShardedParamStore, Any, Dict[str, Any]]:
+    """Re-place a restored payload onto the target spec (elastic)."""
     meta = payload.get("meta", {})
     capacity = int(meta.get("capacity", spec.capacity))
     values = np.asarray(payload["table"])[: min(capacity, spec.capacity)]
@@ -103,23 +113,113 @@ def restore(
     return store, worker_state, meta
 
 
+class JobCheckpointManager:
+    """Step-directory checkpoint manager for the StreamingDriver, backed
+    by ``orbax.CheckpointManager``: atomic per-step commits (a crash mid
+    -write can never destroy the previous durable checkpoint — unlike a
+    single force-overwritten path), retention of the last ``max_to_keep``
+    steps, and optional async writes (``save()`` snapshots device buffers
+    to host — donation-safe — and the disk write overlaps training).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        use_async: bool = False,
+        max_to_keep: int = 2,
+    ):
+        ocp = _ocp()
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=use_async,
+            ),
+        )
+
+    def save(
+        self,
+        step: int,
+        store: ShardedParamStore,
+        worker_state: Any = None,
+        *,
+        extra: Optional[Dict[str, Any]] = None,
+        force: bool = False,
+    ) -> bool:
+        """Returns whether the save was accepted.  Duplicate steps are
+        skipped by orbax unless ``force=True`` (the explicit-save path
+        uses force so "save now" always lands).
+
+        Donation safety: orbax's (a)sync save snapshots device buffers
+        before returning (verified empirically — a jitted step may donate
+        the buffers immediately after this call), and its per-shard
+        serialization avoids a full host gather, so arrays pass straight
+        through (multi-host-safe)."""
+        ocp = _ocp()
+        if force and step in self._mgr.all_steps():
+            # orbax raises on duplicate steps; replace (older retained
+            # steps stay durable through the delete+rewrite window)
+            self.wait()
+            self._mgr.delete(step)
+        return bool(
+            self._mgr.save(
+                step,
+                args=ocp.args.StandardSave(
+                    _make_payload(store, worker_state, step, extra)
+                ),
+            )
+        )
+
+    def latest_step(self) -> Optional[int]:
+        self.wait()
+        return self._mgr.latest_step()
+
+    def restore_latest(
+        self, spec: StoreSpec, worker_state_shardings: Any = None
+    ) -> Optional[Tuple[ShardedParamStore, Any, Dict[str, Any]]]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        payload = self._mgr.restore(step)
+        return _payload_to_state(payload, spec, worker_state_shardings)
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()
+
+
 def load_model(path: str, **from_values_kwargs) -> ShardedParamStore:
-    """The ``transformWithModelLoad`` analogue from a checkpoint file:
-    seed a fresh store from a saved table (SURVEY.md §2 #1)."""
+    """The ``transformWithModelLoad`` analogue from a checkpoint:
+    seed a fresh store from a saved table (SURVEY.md §2 #1).
+
+    ``path`` may be a direct orbax checkpoint (written by :func:`save`) or
+    a :class:`JobCheckpointManager` directory (the latest step is used)."""
     import warnings
 
     ocp = _ocp()
-    with ocp.PyTreeCheckpointer() as ckptr:
-        with warnings.catch_warnings():
-            # intentional: load to host, re-place via from_values below
-            warnings.filterwarnings(
-                "ignore", message="Sharding info not provided"
-            )
-            payload = ckptr.restore(os.path.abspath(path))
+    path = os.path.abspath(path)
+    with warnings.catch_warnings():
+        # intentional: load to host, re-place via from_values below
+        warnings.filterwarnings("ignore", message="Sharding info not provided")
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                payload = ckptr.restore(path)
+        except (FileNotFoundError, ValueError):
+            with ocp.CheckpointManager(path) as mgr:
+                step = mgr.latest_step()
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no checkpoint under {path!r}"
+                    ) from None
+                payload = mgr.restore(step)
     values = np.asarray(payload["table"])[: payload["meta"]["capacity"]]
     return ShardedParamStore.from_values(
         jax.numpy.asarray(values), **from_values_kwargs
     )
 
 
-__all__ = ["save", "restore", "load_model"]
+__all__ = ["save", "restore", "load_model", "JobCheckpointManager"]

@@ -16,7 +16,6 @@ loop implementation, hooked — not duplicated):
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Iterable, Optional
 
 import jax
@@ -68,6 +67,9 @@ class DriverConfig:
     # checkpoint (the crash-recovery path), turning silent divergence
     # into a recoverable fault.  0 = off.
     nan_check_every: int = 0
+    # Periodic saves via orbax AsyncCheckpointer: save() returns after the
+    # device→host copy, disk writes overlap the next training steps.
+    async_checkpoints: bool = False
 
 
 class StreamingDriver:
@@ -98,30 +100,38 @@ class StreamingDriver:
         self.step_idx = 0
         self._state = None
         self._pending_skip = 0
+        self._ckpt_mgr: Optional[ckpt.JobCheckpointManager] = None
+        if self.config.checkpoint_dir is not None:
+            self._ckpt_mgr = ckpt.JobCheckpointManager(
+                self.config.checkpoint_dir,
+                use_async=self.config.async_checkpoints,
+            )
 
     # -- checkpoint/resume -------------------------------------------------
-    def _ckpt_path(self) -> str:
-        assert self.config.checkpoint_dir is not None
-        return os.path.join(self.config.checkpoint_dir, "latest")
+    # Step-directory checkpoints via orbax CheckpointManager: each save
+    # commits atomically to its own step dir (a crash mid-write can never
+    # destroy the previous durable checkpoint), old steps are pruned, and
+    # async mode overlaps disk writes with training.
 
     def save(self) -> None:
-        if self.config.checkpoint_dir is None:
+        if self._ckpt_mgr is None:
             return
-        ckpt.save(
-            self._ckpt_path(), self.store, self._state, step=self.step_idx
-        )
+        # force: an explicit save must land even if this step was already
+        # checkpointed (orbax otherwise silently skips duplicate steps)
+        self._ckpt_mgr.save(self.step_idx, self.store, self._state, force=True)
+        self._ckpt_mgr.wait()  # the explicit save() contract is durable
 
     def resume(self) -> bool:
-        """Restore (store, worker state, step cursor) if a checkpoint
-        exists; returns True on restore.  See class docstring for how the
-        cursor interacts with the next ``run``."""
-        if self.config.checkpoint_dir is None or not os.path.exists(
-            self._ckpt_path()
-        ):
+        """Restore (store, worker state, step cursor) from the latest
+        durable checkpoint if one exists; returns True on restore.  See
+        class docstring for how the cursor interacts with the next
+        ``run``."""
+        if self._ckpt_mgr is None:
             return False
-        self.store, self._state, meta = ckpt.restore(
-            self._ckpt_path(), self.store.spec
-        )
+        restored = self._ckpt_mgr.restore_latest(self.store.spec)
+        if restored is None:
+            return False
+        self.store, self._state, meta = restored
         self.step_idx = int(meta.get("step", 0))
         self._pending_skip = self.step_idx
         return True
@@ -207,14 +217,14 @@ class StreamingDriver:
             if cfg.checkpoint_every and global_step % cfg.checkpoint_every == 0:
                 # Save straight from the live buffers WITHOUT stashing them
                 # on self: the next jitted step donates (deletes) them, and
-                # self.store must never hold a deleted array.  orbax save is
-                # synchronous, so the bytes are serialized before donation.
-                if cfg.checkpoint_dir is not None:
-                    ckpt.save(
-                        self._ckpt_path(),
-                        ShardedParamStore(spec, table),
-                        state,
-                        step=global_step,
+                # self.store must never hold a deleted array.  Both save
+                # modes copy the data off-device before returning (the sync
+                # path serializes fully; the async path returns after the
+                # host copy and writes in the background), so donation is
+                # safe either way.
+                if self._ckpt_mgr is not None:
+                    self._ckpt_mgr.save(
+                        global_step, ShardedParamStore(spec, table), state
                     )
 
         try:
@@ -233,10 +243,7 @@ class StreamingDriver:
             # The in-flight table/state buffers were donated; leave the
             # driver usable by reloading the last durable checkpoint (if
             # any) before propagating.
-            if (
-                self.config.checkpoint_dir is not None
-                and os.path.exists(self._ckpt_path())
-            ):
+            if self._ckpt_mgr is not None:
                 self.resume()
             raise
         finally:

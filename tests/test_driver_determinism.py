@@ -56,7 +56,7 @@ def test_driver_runs_with_metrics(tmp_path):
 def test_driver_checkpoint_and_resume(tmp_path):
     d1 = _driver(tmp_path, checkpoint_every=10)
     d1.run(_stream())
-    assert os.path.exists(os.path.join(str(tmp_path), "latest"))
+    assert d1._ckpt_mgr.latest_step() == 20  # final durable save
 
     # Fresh driver resumes from the saved cursor and state.
     d2 = _driver(tmp_path)
@@ -201,3 +201,35 @@ def test_nan_guard_blocks_poisoned_checkpoint(tmp_path):
         d.run(poisoned())
     assert d.step_idx == 5
     assert np.isfinite(np.asarray(d.store.values())).all()
+
+
+def test_async_checkpoints_match_sync(tmp_path):
+    """async_checkpoints=True produces the same checkpoint/resume state as
+    the synchronous path (saves drain before any read or rewrite)."""
+    d_sync = _driver(tmp_path / "sync", checkpoint_every=7)
+    d_sync.run(_stream())
+    d_async = _driver(tmp_path / "async", checkpoint_every=7,
+                      async_checkpoints=True)
+    d_async.run(_stream())
+
+    r_sync = _driver(tmp_path / "sync")
+    r_async = _driver(tmp_path / "async", async_checkpoints=True)
+    assert r_sync.resume() and r_async.resume()
+    assert r_sync.step_idx == r_async.step_idx == 20
+    np.testing.assert_allclose(
+        np.asarray(r_sync.store.values()), np.asarray(r_async.store.values())
+    )
+    # mid-run crash recovery also drains correctly
+    d2 = _driver(tmp_path / "async", checkpoint_every=5,
+                 async_checkpoints=True, nan_check_every=1)
+    from flink_parameter_server_tpu.training.driver import TrainingDiverged
+
+    def poisoned():
+        for i, b in enumerate(_stream()):
+            if i == 8:
+                b = dict(b, rating=b["rating"] * np.nan)
+            yield b
+
+    with pytest.raises(TrainingDiverged):
+        d2.run(poisoned(), fast_forward=False)
+    assert np.isfinite(np.asarray(d2.store.values())).all()

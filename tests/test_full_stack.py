@@ -92,6 +92,6 @@ def test_full_stack_job(tmp_path, mesh):
 
     # 5. model-load path: restore the dumped table into a fresh store and
     # serve identically
-    loaded = checkpoint.load_model(str(tmp_path / "ckpt" / "latest"))
+    loaded = checkpoint.load_model(str(tmp_path / "ckpt"))
     scores2, ids2 = query_topk(loaded, res.worker_state, jnp.arange(4), k=5)
     np.testing.assert_array_equal(np.asarray(ids), np.asarray(ids2))
