@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import abc
 import collections
-from typing import Any, Callable, Generic, TypeVar
+from typing import Callable, Generic, TypeVar
 
 T = TypeVar("T")  # training-data record type
 P = TypeVar("P")  # parameter value type

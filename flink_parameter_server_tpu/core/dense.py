@@ -11,7 +11,7 @@ collective-native replacement for the reference's per-key Netty routing.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Optional, Tuple
+from typing import Any, Callable, Iterable, List, Optional
 
 import jax
 import optax

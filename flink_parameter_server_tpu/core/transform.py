@@ -37,7 +37,7 @@ from .api import (
     SimplePSLogic,
     WorkerLogic,
 )
-from .batched import BatchedWorkerLogic, PushRequest
+from .batched import BatchedWorkerLogic
 from .entities import Pull, PullAnswer, Push, PSToWorker, WorkerToPS
 from .store import ShardedParamStore
 from ..parallel.mesh import DP_AXIS

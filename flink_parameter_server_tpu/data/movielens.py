@@ -9,8 +9,7 @@ scatter-add path, so the synthetic set preserves it.
 """
 from __future__ import annotations
 
-import os
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 

@@ -10,8 +10,7 @@ equivalent").
 """
 from __future__ import annotations
 
-import itertools
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 

@@ -20,7 +20,7 @@ convention) or squared loss SGD; the global bias is a reserved feature id
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ the same hogwild-style interleaving the reference embraces across workers
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
 import jax

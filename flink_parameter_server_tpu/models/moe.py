@@ -23,7 +23,7 @@ capacity/ordering semantics for parity tests.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,7 @@ the reference's per-class vectors re-laid-out for one gather instead of C.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

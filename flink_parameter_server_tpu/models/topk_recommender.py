@@ -14,13 +14,11 @@ reference interleaves query events in the input stream.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from ..core.batched import PushRequest
 from ..core.store import ShardedParamStore
 from ..ops.topk import dense_topk, sharded_topk
 from .matrix_factorization import OnlineMatrixFactorization

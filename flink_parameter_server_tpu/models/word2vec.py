@@ -17,7 +17,6 @@ stream (unigram^0.75), or on-device via ``sample_negatives``.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Dict, Optional, Tuple
 
 import jax

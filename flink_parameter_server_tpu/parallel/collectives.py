@@ -24,7 +24,6 @@ an affine id-permutation to spread them.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

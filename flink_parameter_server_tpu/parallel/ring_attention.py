@@ -21,7 +21,6 @@ least one valid key (no -inf softmax rows).
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax

@@ -16,7 +16,7 @@ loop implementation, hooked — not duplicated):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Iterable, Optional
+from typing import Iterable, Optional
 
 import jax
 import jax.numpy as jnp

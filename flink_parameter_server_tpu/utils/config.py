@@ -9,7 +9,7 @@ typed lookup, no third-party flag library.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 def _norm(key: str) -> str:
