@@ -21,6 +21,7 @@ from .core.api import (
 from .core.batched import BatchedWorkerLogic, PushRequest
 from .core.dense import DenseParameterServer, transform_dense
 from .core.entities import Pull, PullAnswer, Push, PSToWorker, WorkerToPS
+from .core.hybrid import transform_hybrid
 from .core.store import ShardedParamStore, StoreSpec
 from .core.transform import (
     TransformResult,
@@ -53,6 +54,7 @@ __all__ = [
     "transform",
     "transform_batched",
     "transform_with_model_load",
+    "transform_hybrid",
     "make_mesh",
     "DP_AXIS",
     "PS_AXIS",

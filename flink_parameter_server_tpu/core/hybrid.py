@@ -1,0 +1,165 @@
+"""Hybrid backend: reference-style event callbacks + the device store.
+
+The migration middle path between the two programming models
+(SURVEY.md §7 "Guiding translation"):
+
+  * the **event API** (``core.api.WorkerLogic``) runs arbitrary Python per
+    record but keeps parameters in host HashMaps,
+  * the **batched API** compiles everything but requires rewriting the
+    logic as pure functions.
+
+``transform_hybrid`` runs an *unmodified* ``WorkerLogic`` against a
+:class:`ShardedParamStore`: per chunk of records it collects every
+``pull`` the callbacks issue, answers them all with ONE sharded gather,
+dispatches the answers back into ``on_pull_recv``, and folds every
+``push`` with ONE sharded scatter-add.  Python still executes the per
+-record math (no jit speedup for the worker logic itself), but the
+parameter plane — the reference's per-message Netty traffic — becomes
+two device collectives per chunk, and the model lives in HBM at any
+scale.  Value-shape note: logics must push deltas matching the store's
+``value_shape``.
+
+Staleness semantics: pulls within a chunk observe the store as of the
+chunk start; pushes land at chunk end (bounded staleness of one chunk —
+between the reference's unbounded races and the batched backend's one
+microbatch).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .api import ParameterServerClient, WorkerLogic
+from .store import ShardedParamStore
+from .transform import TransformResult, _instances
+
+
+class _HybridClient(ParameterServerClient):
+    """Buffers the callbacks' pull/push traffic for chunk-level batching."""
+
+    def __init__(self):
+        self.pull_requests: List[int] = []
+        self.push_ids: List[int] = []
+        self.push_deltas: List[Any] = []
+        self.outputs: List[Any] = []
+
+    def pull(self, param_id: int) -> None:
+        self.pull_requests.append(param_id)
+
+    def push(self, param_id: int, delta) -> None:
+        self.push_ids.append(param_id)
+        self.push_deltas.append(np.asarray(delta))
+
+    def output(self, w_out) -> None:
+        self.outputs.append(w_out)
+
+
+def transform_hybrid(
+    data: Iterable,
+    worker_logic: Union[WorkerLogic, Callable[[], WorkerLogic]],
+    store: ShardedParamStore,
+    *,
+    chunk_size: int = 1024,
+    worker_parallelism: int = 1,
+    partitioner: Optional[Callable[[Any, int], int]] = None,
+    dump_model: bool = True,
+) -> TransformResult:
+    """Run an event-API worker logic against a sharded device store.
+
+    Per chunk: deliver records (``on_recv``) buffering pulls → one
+    ``store.pull`` for all unique ids → deliver answers
+    (``on_pull_recv``), buffering any follow-up pulls/pushes (follow-up
+    pulls are answered from the same chunk snapshot) → one
+    ``store.push`` of all buffered deltas.
+    """
+    workers = _instances(worker_logic, worker_parallelism, "worker")
+    clients = [_HybridClient() for _ in workers]
+    worker_outputs: List[Any] = []
+
+    import itertools
+
+    rr = itertools.cycle(range(len(workers)))
+
+    def check_ids(ids, what: str) -> None:
+        # unlike the event backend (arbitrary hashable keys), the device
+        # store is integer-indexed: fail loudly instead of crashing deep
+        # inside JAX (non-int) or silently clipping/dropping (OOB)
+        for pid in ids:
+            if not isinstance(pid, (int, np.integer)):
+                raise TypeError(
+                    f"transform_hybrid requires integer param ids; "
+                    f"{what} got {pid!r} — remap keys to ints for the "
+                    f"device store"
+                )
+            if not 0 <= pid < store.spec.capacity:
+                raise ValueError(
+                    f"{what} id {pid} out of range for store capacity "
+                    f"{store.spec.capacity}"
+                )
+
+    def flush_chunk(records: List[Tuple[int, Any]]) -> None:
+        nonlocal store
+        # 1. deliver records; callbacks buffer pulls/pushes
+        for widx, record in records:
+            workers[widx].on_recv(record, clients[widx])
+        # 2. answer ALL buffered pulls — deduped, one snapshot gather per
+        # round; follow-up pulls issued inside on_pull_recv are answered
+        # against the same snapshot until none remain
+        while any(c.pull_requests for c in clients):
+            requests = [(w, pid) for w, c in enumerate(clients)
+                        for pid in c.pull_requests]
+            for c in clients:
+                c.pull_requests = []
+            check_ids([pid for _w, pid in requests], "pull")
+            unique, inverse = np.unique(
+                np.asarray([pid for _w, pid in requests], np.int64),
+                return_inverse=True,
+            )
+            values = np.asarray(store.pull(jnp.asarray(unique, jnp.int32)))
+            for (widx, pid), uidx in zip(requests, inverse):
+                workers[widx].on_pull_recv(pid, values[uidx], clients[widx])
+        # 3. one scatter-add for every buffered push
+        all_ids = [pid for c in clients for pid in c.push_ids]
+        check_ids(all_ids, "push")
+        if all_ids:
+            all_deltas = np.stack(
+                [d for c in clients for d in c.push_deltas]
+            ).astype(store.table.dtype)
+            store = store.push(
+                jnp.asarray(all_ids, jnp.int32), jnp.asarray(all_deltas)
+            )
+        for c in clients:
+            c.push_ids, c.push_deltas = [], []
+            worker_outputs.extend(c.outputs)
+            c.outputs = []
+
+    chunk: List[Tuple[int, Any]] = []
+    for record in data:
+        widx = (
+            partitioner(record, len(workers)) if partitioner else next(rr)
+        )
+        chunk.append((widx, record))
+        if len(chunk) >= chunk_size:
+            flush_chunk(chunk)
+            chunk = []
+    if chunk:
+        flush_chunk(chunk)
+
+    for w in workers:
+        w.close()
+
+    server_outputs: List[Any] = []
+    if dump_model:
+        server_outputs.append(
+            (np.arange(store.spec.capacity), np.asarray(store.values()))
+        )
+    return TransformResult(
+        worker_outputs=worker_outputs,
+        server_outputs=server_outputs,
+        store=store,
+    )
+
+
+__all__ = ["transform_hybrid"]

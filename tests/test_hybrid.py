@@ -1,0 +1,114 @@
+"""Hybrid backend: unmodified event-API logics on the device store."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.core.hybrid import transform_hybrid
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.models.matrix_factorization import (
+    MFWorkerLogic,
+    SGDUpdater,
+)
+from flink_parameter_server_tpu.utils.initializers import (
+    ranged_random_factor,
+    zeros,
+)
+
+
+def test_hybrid_mf_matches_event_backend_math():
+    """The unmodified MFWorkerLogic trains against the device store; with
+    chunk_size=1 the result matches the pure event backend exactly."""
+    from flink_parameter_server_tpu import SimplePSLogic, transform
+
+    rng = np.random.default_rng(0)
+    ratings = [
+        (int(rng.integers(0, 10)), int(rng.integers(0, 12)),
+         float(rng.normal()))
+        for _ in range(120)
+    ]
+    updater = SGDUpdater(0.05)
+
+    # pure event backend (host HashMap store)
+    w_ev = MFWorkerLogic(dim=4, updater=updater, seed=0)
+    item_init = ranged_random_factor(1, (4,))
+    res_ev = transform(
+        list(ratings), w_ev,
+        SimplePSLogic(
+            init=lambda i: np.asarray(item_init(jnp.array([i]))[0]),
+            update=lambda c, d: c + np.asarray(d),
+        ),
+    )
+    ev_items = np.zeros((12, 4), np.float32)
+    for i, v in res_ev.server_outputs:
+        ev_items[i] = v
+
+    # hybrid: same logic class, device store, chunk 1 = identical schedule
+    w_hy = MFWorkerLogic(dim=4, updater=updater, seed=0)
+    store = ShardedParamStore.create(12, (4,), init_fn=item_init)
+    res_hy = transform_hybrid(list(ratings), w_hy, store, chunk_size=1)
+    np.testing.assert_allclose(
+        np.asarray(res_hy.store.values()), ev_items, atol=1e-5
+    )
+    assert len(res_hy.worker_outputs) == len(res_ev.worker_outputs)
+
+
+def test_hybrid_chunked_converges(mesh):
+    """Chunked (bounded-staleness) hybrid on a sharded store converges."""
+    rng = np.random.default_rng(1)
+    P = rng.normal(0, 0.5, (30, 3))
+    Q = rng.normal(0, 0.5, (40, 3))
+    ratings = []
+    for _ in range(3000):
+        u, i = int(rng.integers(0, 30)), int(rng.integers(0, 40))
+        ratings.append((u, i, float(P[u] @ Q[i] + rng.normal(0, 0.02))))
+
+    worker = MFWorkerLogic(dim=6, updater=SGDUpdater(0.08), seed=0)
+    store = ShardedParamStore.create(
+        40, (6,), init_fn=ranged_random_factor(1, (6,)), mesh=mesh
+    )
+    res = transform_hybrid(ratings * 4, worker, store, chunk_size=256)
+    item_f = np.asarray(res.store.values())
+    user_f = np.zeros((30, 6), np.float32)
+    for u, v in worker.user_vectors.items():
+        user_f[u] = v
+    pred = np.array([user_f[u] @ item_f[i] for u, i, _r in ratings])
+    truth = np.array([r for _u, _i, r in ratings])
+    rmse = float(np.sqrt(np.mean((pred - truth) ** 2)))
+    base = float(np.sqrt(np.mean(truth**2)))
+    assert rmse < 0.6 * base, (rmse, base)
+
+
+def test_hybrid_multi_worker_partitioning():
+    """Counting logic across 3 workers with a key partitioner."""
+    from tests.test_transform_local import CountingWorker
+
+    store = ShardedParamStore.create(8, (), init_fn=zeros(()))
+    data = [(k, 1.0) for k in [0, 1, 2, 3] * 25]
+    res = transform_hybrid(
+        data,
+        CountingWorker,
+        store,
+        chunk_size=16,
+        worker_parallelism=3,
+        partitioner=lambda rec, n: rec[0] % n,
+    )
+    vals = np.asarray(res.store.values())
+    np.testing.assert_allclose(vals[:4], [25, 25, 25, 25])
+    assert len(res.worker_outputs) == 100
+
+
+def test_hybrid_rejects_bad_ids():
+    class StrKeys(MFWorkerLogic):
+        def on_recv(self, d, ps):
+            ps.pull("a")  # event backend allows this; hybrid must not
+
+    store = ShardedParamStore.create(4, (4,))
+    with pytest.raises(TypeError, match="integer param ids"):
+        transform_hybrid([(0, 0, 0.0)], StrKeys(dim=4), store, chunk_size=1)
+
+    class OOB(MFWorkerLogic):
+        def on_recv(self, d, ps):
+            ps.pull(99)
+
+    with pytest.raises(ValueError, match="out of range"):
+        transform_hybrid([(0, 0, 0.0)], OOB(dim=4), store, chunk_size=1)
