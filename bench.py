@@ -74,7 +74,7 @@ def tpu_updates_per_sec(
     num_users=100_000,
     num_items=131_072,
     dim=64,
-    batch=16_384,
+    batch=None,
     warmup_steps=3,
     bench_steps=30,
     dtype=None,
@@ -90,6 +90,20 @@ def tpu_updates_per_sec(
     )
     from flink_parameter_server_tpu.utils.initializers import normal_factor
 
+    if batch is None:
+        # one TPU chip sustains much larger microbatches before going
+        # compute-bound (tables are ~30 MB; batch arrays are trivial);
+        # the CPU backend stays small to keep the fallback run short.
+        default_batch = 65_536 if jax.default_backend() == "tpu" else 16_384
+        raw = os.environ.get("FPS_BENCH_BATCH", str(default_batch))
+        try:
+            batch = int(raw)
+        except ValueError:
+            raise SystemExit(
+                f"FPS_BENCH_BATCH={raw!r}: expected a positive integer"
+            ) from None
+        if batch <= 0:
+            raise SystemExit(f"FPS_BENCH_BATCH={batch}: must be positive")
     if dtype is None:
         # bfloat16 is the TPU-native table dtype (halves HBM gather/
         # scatter bytes) but is *emulated* (≈10× slower) on the CPU
@@ -143,7 +157,7 @@ def tpu_updates_per_sec(
         jax.block_until_ready(table)
         lats.append(time.perf_counter() - t1)
     p50_ms = float(np.percentile(np.array(lats), 50) * 1e3)
-    return updates_per_sec, p50_ms, jnp.dtype(dtype).name
+    return updates_per_sec, p50_ms, jnp.dtype(dtype).name, batch
 
 
 def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
@@ -180,7 +194,7 @@ def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
-    tpu_rate, p50_ms, table_dtype = tpu_updates_per_sec()
+    tpu_rate, p50_ms, table_dtype, batch = tpu_updates_per_sec()
     cpu_rate = cpu_per_record_baseline()
     metric = "MF-SGD updates/sec/chip (synthetic MovieLens-like, Zipf items)"
     if fallback:
@@ -194,6 +208,7 @@ def main():
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "extra": {
                     "pull_push_p50_ms": round(p50_ms, 3),
+                    "batch": batch,
                     "per_record_baseline_updates_per_sec": round(cpu_rate, 1),
                     "platform": platform,
                     "table_dtype": table_dtype,

@@ -23,6 +23,13 @@ Staleness semantics: pulls within a chunk observe the store as of the
 chunk start; pushes land at chunk end (bounded staleness of one chunk —
 between the reference's unbounded races and the batched backend's one
 microbatch).
+
+Custom (non-"add") store ``update`` functions: duplicate-id pushes
+within one chunk are summed BEFORE ``update`` applies once per id
+(:class:`~..core.store.StoreSpec` semantics) — the event backend applies
+``update`` per push instead, so non-commutative updates diverge between
+the two backends for intra-chunk duplicates.  Use ``chunk_size=1`` for
+exact per-push semantics.
 """
 from __future__ import annotations
 

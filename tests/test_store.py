@@ -139,3 +139,19 @@ def test_push_out_of_range_ids_are_dropped():
     got = np.asarray(out.values())
     assert got[9] == 2.0
     assert got.sum() == 2.0  # nothing else was touched
+
+
+def test_generic_update_fn_sharded(mesh):
+    """Custom (non-add) update path on a sharded mesh matches the
+    single-device result."""
+    def ema(current, combined):
+        return 0.5 * current + 0.5 * combined
+
+    def run(m):
+        s = ShardedParamStore.create(12, (2,), init_fn=zeros((2,)),
+                                     update=ema, mesh=m)
+        s = s.push(jnp.array([0, 3, 0]), jnp.ones((3, 2)) * 4.0)
+        s = s.push(jnp.array([3]), jnp.zeros((1, 2)))
+        return np.asarray(s.values())
+
+    np.testing.assert_allclose(run(mesh), run(None), atol=1e-6)
