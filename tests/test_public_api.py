@@ -1,0 +1,89 @@
+"""Public-API surface stability: everything the docs promise imports.
+
+Guards against accidental export regressions between rounds; update this
+list deliberately alongside docs/api.md.
+"""
+import importlib
+
+import pytest
+
+TOP_LEVEL = [
+    "transform", "transform_batched", "transform_hybrid",
+    "transform_with_model_load", "transform_dense",
+    "WorkerLogic", "ParameterServerLogic", "ParameterServerClient",
+    "ParameterServer", "SimplePSLogic", "add_pull_limiter",
+    "BatchedWorkerLogic", "PushRequest",
+    "ShardedParamStore", "StoreSpec", "DenseParameterServer",
+    "TransformResult", "make_mesh", "DP_AXIS", "PS_AXIS",
+    "StreamingDriver", "DriverConfig",
+    "Pull", "Push", "PullAnswer", "WorkerToPS", "PSToWorker",
+]
+
+MODULE_SYMBOLS = {
+    "flink_parameter_server_tpu.core.senders": ["SenderPolicy"],
+    "flink_parameter_server_tpu.parallel.collectives": [
+        "shard_pull", "shard_push_add"],
+    "flink_parameter_server_tpu.parallel.ring_attention": [
+        "ring_attention", "reference_attention"],
+    "flink_parameter_server_tpu.parallel.pipeline": [
+        "pipeline_apply", "stack_stage_params"],
+    "flink_parameter_server_tpu.parallel.multihost": [
+        "initialize", "make_multihost_mesh", "process_local_batch_slice"],
+    "flink_parameter_server_tpu.training.checkpoint": [
+        "save", "restore", "load_model", "JobCheckpointManager"],
+    "flink_parameter_server_tpu.training.metrics": ["StepMetrics"],
+    "flink_parameter_server_tpu.training.tracing": [
+        "profile_trace", "scope", "device_memory_stats"],
+    "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
+    "flink_parameter_server_tpu.models.matrix_factorization": [
+        "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",
+        "ps_online_mf", "make_locality_mf_step"],
+    "flink_parameter_server_tpu.models.topk_recommender": [
+        "query_topk", "make_mf_topk_step"],
+    "flink_parameter_server_tpu.models.passive_aggressive": [
+        "PARule", "transform_binary", "transform_multiclass",
+        "PABinaryWorkerLogic"],
+    "flink_parameter_server_tpu.models.word2vec": [
+        "SkipGramNS", "train_skipgram", "sample_negatives"],
+    "flink_parameter_server_tpu.models.factorization_machine": [
+        "FMConfig", "train_fm"],
+    "flink_parameter_server_tpu.models.sketches": [
+        "CountMinSketch", "BloomCooccurrence", "TugOfWarSketch", "decay"],
+    "flink_parameter_server_tpu.models.transformer": [
+        "TransformerConfig", "init_params", "forward", "forward_pipelined",
+        "lm_loss", "next_token_xent", "param_shardings"],
+    "flink_parameter_server_tpu.models.moe": [
+        "MoEConfig", "init_moe_params", "moe_apply", "moe_dense"],
+    "flink_parameter_server_tpu.ops.topk": ["dense_topk", "sharded_topk"],
+    "flink_parameter_server_tpu.ops.hashing": [
+        "hash_params", "bucket_hash", "sign_hash", "pair_key", "permute_ids"],
+    "flink_parameter_server_tpu.ops.dedup": [
+        "occurrence_counts", "occurrence_scale"],
+    "flink_parameter_server_tpu.ops.pallas_scatter": ["scatter_add"],
+    "flink_parameter_server_tpu.data.streams": [
+        "microbatches", "partitioned_microbatches", "sparse_feature_batches",
+        "prefetch", "from_collection"],
+    "flink_parameter_server_tpu.data.movielens": [
+        "synthetic_ratings", "load_movielens"],
+    "flink_parameter_server_tpu.data.text": [
+        "synthetic_corpus", "skipgram_batches", "cooccurrence_pairs"],
+    "flink_parameter_server_tpu.data.native_loader": [
+        "load_ratings", "stream_batches", "NativeUnavailable"],
+    "flink_parameter_server_tpu.utils.initializers": [
+        "ranged_random_factor", "normal_factor", "zeros"],
+    "flink_parameter_server_tpu.utils.config": ["Parameters"],
+}
+
+
+def test_top_level_exports():
+    import flink_parameter_server_tpu as fps
+
+    missing = [n for n in TOP_LEVEL if not hasattr(fps, n)]
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("module", sorted(MODULE_SYMBOLS))
+def test_module_symbols(module):
+    mod = importlib.import_module(module)
+    missing = [n for n in MODULE_SYMBOLS[module] if not hasattr(mod, n)]
+    assert not missing, (module, missing)
