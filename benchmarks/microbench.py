@@ -98,8 +98,9 @@ def bench_mf(batch=16_384, dim=64):
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import tpu_updates_per_sec
 
-    rate, p50, dtype = tpu_updates_per_sec(batch=batch, dim=dim)
-    print(f"mf_updates_per_sec {rate:,.0f}  p50 {p50:.3f} ms  dtype {dtype}")
+    rate, p50, dtype, batch = tpu_updates_per_sec(batch=batch, dim=dim)
+    print(f"mf_updates_per_sec {rate:,.0f}  p50 {p50:.3f} ms  "
+          f"dtype {dtype}  batch {batch}")
 
 
 SECTIONS = {
