@@ -130,6 +130,16 @@ def push(
     batches (SURVEY.md §7 "Dynamic shapes").  Out-of-range ids are dropped
     (``mode="drop"``), matching :func:`..parallel.collectives.shard_push_add`.
     """
+    vr = len(spec.value_shape)
+    lead = tuple(deltas.shape[: deltas.ndim - vr])
+    if (vr and tuple(deltas.shape[deltas.ndim - vr:]) != spec.value_shape) or (
+        lead != tuple(ids.shape)
+    ):
+        raise ValueError(
+            f"push deltas shape {tuple(deltas.shape)} does not match ids "
+            f"shape {tuple(ids.shape)} + store value shape "
+            f"{spec.value_shape}"
+        )
     ids = ids.astype(jnp.int32)
     flat_ids = ids.reshape(-1)
     # Negative ids would wrap (numpy semantics) before mode="drop" applies;

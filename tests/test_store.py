@@ -155,3 +155,16 @@ def test_generic_update_fn_sharded(mesh):
         return np.asarray(s.values())
 
     np.testing.assert_allclose(run(mesh), run(None), atol=1e-6)
+
+
+def test_push_wrong_value_shape_clear_error():
+    store = ShardedParamStore.create(10, (4,), init_fn=zeros((4,)))
+    with pytest.raises(ValueError, match=r"deltas shape \(1, 3\)"):
+        store.push(jnp.array([1]), jnp.ones((1, 3)))
+    # batch-count mismatch (trailing dim coincidentally == value shape)
+    with pytest.raises(ValueError, match=r"does not match ids"):
+        store.push(jnp.arange(4), jnp.ones((4,)))
+    # scalar stores get the guard too
+    s0 = ShardedParamStore.create(6, (), init_fn=zeros(()))
+    with pytest.raises(ValueError, match=r"does not match ids"):
+        s0.push(jnp.array([0, 1]), jnp.ones((3,)))
