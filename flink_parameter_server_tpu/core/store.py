@@ -140,6 +140,12 @@ def push(
             f"shape {tuple(ids.shape)} + store value shape "
             f"{spec.value_shape}"
         )
+    if mask is not None and tuple(mask.shape) != tuple(ids.shape):
+        # a length-1 mask would silently broadcast across every lane
+        raise ValueError(
+            f"push mask shape {tuple(mask.shape)} does not match ids shape "
+            f"{tuple(ids.shape)}"
+        )
     ids = ids.astype(jnp.int32)
     flat_ids = ids.reshape(-1)
     # Negative ids would wrap (numpy semantics) before mode="drop" applies;

@@ -168,3 +168,9 @@ def test_push_wrong_value_shape_clear_error():
     s0 = ShardedParamStore.create(6, (), init_fn=zeros(()))
     with pytest.raises(ValueError, match=r"does not match ids"):
         s0.push(jnp.array([0, 1]), jnp.ones((3,)))
+
+
+def test_push_mask_shape_mismatch_clear_error():
+    store = ShardedParamStore.create(8, (), init_fn=zeros(()))
+    with pytest.raises(ValueError, match="mask shape"):
+        store.push(jnp.array([2, 5, 0]), jnp.ones(3), mask=jnp.array([False]))
