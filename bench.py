@@ -33,27 +33,22 @@ def _ensure_backend_alive() -> str:
 
     The probe runs in a *subprocess*: a wedged PJRT client init blocks in
     C++ with the GIL held, so in-process SIGALRM handlers never fire."""
-    import subprocess
-
     if os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1":
         import jax
 
         return jax.devices()[0].platform
 
-    timeout = int(os.environ.get("FPS_BENCH_INIT_TIMEOUT", "240"))
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True,
-            timeout=timeout,
-            text=True,
-        )
-        if probe.returncode == 0 and probe.stdout.strip():
-            import jax
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
 
-            return jax.devices()[0].platform
-    except subprocess.TimeoutExpired:
-        pass
+    alive, detail = probe_backend(
+        env_var="FPS_BENCH_INIT_TIMEOUT", default_timeout=240
+    )
+    if alive:
+        import jax
+
+        return jax.devices()[0].platform
+    print(f"bench: {detail} — re-exec on cpu", file=sys.stderr, flush=True)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     repo_dir = os.path.dirname(os.path.abspath(__file__))

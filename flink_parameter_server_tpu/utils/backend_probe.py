@@ -1,0 +1,82 @@
+"""Timed JAX-backend liveness probe (shared by bench.py / __graft_entry__).
+
+This image's remote-TPU PJRT plugin can block backend init forever on a
+dead tunnel, in C++ with the GIL held — so the probe must run in a
+SUBPROCESS.  Hardening that both callers need:
+
+  * output goes to a temp FILE, not pipes: on timeout CPython kills only
+    the direct child then drains the pipes without a timeout, so a wedged
+    grandchild holding the pipe fds would hang the parent forever — the
+    exact failure this probe exists to avoid; file fds need no drain,
+  * the probe runs in its own session and the whole process group is
+    killed on timeout (tunnel helpers die with it),
+  * a fast nonzero exit is reported as a failure WITH the child's output
+    (a rejected connection is not a hang — don't misdiagnose it),
+  * results are cached per process (callers often probe more than once).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Tuple
+
+_cached: Optional[Tuple[bool, str]] = None
+
+
+def _timeout(env_var: str, default: int) -> int:
+    raw = os.environ.get(env_var, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def probe_backend(
+    timeout: Optional[int] = None,
+    *,
+    env_var: str = "FPS_BACKEND_PROBE_TIMEOUT",
+    default_timeout: int = 120,
+    use_cache: bool = True,
+) -> Tuple[bool, str]:
+    """Returns (alive, detail).  ``alive`` means a fresh subprocess
+    completed ``jax.devices()`` within the timeout."""
+    global _cached
+    if use_cache and _cached is not None:
+        return _cached
+    if timeout is None:
+        timeout = _timeout(env_var, default_timeout)
+
+    with tempfile.TemporaryFile() as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=out,
+            stderr=out,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            result = (False, f"backend init unresponsive after {timeout}s")
+            if use_cache:
+                _cached = result
+            return result
+        out.seek(0)
+        tail = out.read()[-2000:].decode(errors="replace").strip()
+    if rc == 0:
+        result = (True, "ok")
+    else:
+        result = (False, f"backend probe failed (exit {rc}): {tail}")
+    if use_cache:
+        _cached = result
+    return result
+
+
+__all__ = ["probe_backend"]
