@@ -100,3 +100,41 @@ def test_bench_multichip_path(monkeypatch):
     ps = next((c for c in (4, 2) if n % c == 0), 1)
     assert batch == 16 * (n // ps)
     assert rate > 0 and p50 > 0 and dtype_name == "float32"
+
+
+def test_backend_probe_timeout_and_cache(monkeypatch):
+    """The probe reports a wedged backend without hanging, and caches."""
+    from flink_parameter_server_tpu.utils import backend_probe
+
+    # this test process env points at the wedged TPU plugin, so a real
+    # subprocess probe with a tiny timeout must come back (False, ...)
+    monkeypatch.setattr(backend_probe, "_cached", None)
+    alive, detail = backend_probe.probe_backend(timeout=3, use_cache=True)
+    assert not alive and "unresponsive after 3s" in detail
+    # cached: second call returns instantly with the same result
+    import time
+
+    t0 = time.perf_counter()
+    again = backend_probe.probe_backend(timeout=600)
+    assert again == (alive, detail)
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_backend_probe_failure_reports_child_output(monkeypatch):
+    from flink_parameter_server_tpu.utils import backend_probe
+
+    monkeypatch.setattr(backend_probe, "_cached", None)
+    monkeypatch.setattr(
+        backend_probe.sys, "executable", backend_probe.sys.executable
+    )
+    # force a fast failure by probing with a python that errors out
+    real_popen = backend_probe.subprocess.Popen
+
+    def fake_popen(cmd, **kw):
+        return real_popen(
+            [cmd[0], "-c", "import sys; print('boom'); sys.exit(3)"], **kw
+        )
+
+    monkeypatch.setattr(backend_probe.subprocess, "Popen", fake_popen)
+    alive, detail = backend_probe.probe_backend(timeout=30, use_cache=False)
+    assert not alive and "exit 3" in detail and "boom" in detail
