@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.ring_attention import reference_attention, ring_attention
+from ..parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+    ring_attention_inner,
+)
 
 Array = jax.Array
 
@@ -178,22 +182,42 @@ def _apply_block(
     cfg: TransformerConfig,
     mesh: Optional[Mesh],
     constrain=None,
+    ring_inner: Optional[Dict] = None,
 ) -> Array:
     """One pre-norm residual block (attention + MLP) on (B, T, d).
 
     ``constrain``: optional activation-sharding anchor applied to the
     attention-residual output (keeps XLA's propagation from resharding
-    mid-block on dp/sp meshes)."""
+    mid-block on dp/sp meshes).
+
+    ``ring_inner``: set when this block already runs INSIDE a shard_map
+    (pipeline stages) whose mesh carries the sp axis — shard_maps don't
+    nest, so attention uses :func:`ring_attention_inner` directly.  Keys:
+    ``sp_axis``, ``num_blocks``, and ``pos_offset`` (this shard's global
+    position of local token 0, for RoPE)."""
     B, T, _d = x.shape
     H, Dh = cfg.n_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (B, T))
+    if ring_inner is not None:
+        positions = positions + ring_inner["pos_offset"]
     h = _rmsnorm(x, layer["attn_norm"])
     qkv = h @ layer["wqkv"]  # (B, T, 3·d)
     qkv = qkv.reshape(B, T, 3, H, Dh)
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     q = _rope(q, positions)
     k = _rope(k, positions)
-    if cfg.use_ring_attention and mesh is not None and cfg.sp_axis:
+    if ring_inner is not None:
+        attn = ring_attention_inner(
+            q, k, v,
+            sp_axis=ring_inner["sp_axis"],
+            num_blocks=ring_inner["num_blocks"],
+        )
+    elif (
+        cfg.use_ring_attention
+        and mesh is not None
+        and cfg.sp_axis
+        and cfg.sp_axis in mesh.axis_names
+    ):
         attn = ring_attention(
             q, k, v,
             mesh=mesh,
@@ -288,8 +312,10 @@ def forward_pipelined(
     ``cfg.pp_axis`` (GPipe schedule, :mod:`..parallel.pipeline`):
     each stage holds ``n_layers / pp`` blocks; microbatches stream
     through the stage ring.  Embed / final norm / logits run replicated
-    outside the pipeline.  Dense attention inside stages (ring+pp
-    composition is future work)."""
+    outside the pipeline.  With ``cfg.use_ring_attention`` + a mesh that
+    also carries ``cfg.sp_axis``, the sequence dim stays sp-sharded
+    through the pipeline and each stage runs ring attention over the sp
+    ring (PP × SP composition)."""
     from ..parallel.pipeline import pipeline_apply, stack_stage_params
 
     assert cfg.pp_axis and cfg.pp_axis in mesh.axis_names
@@ -303,11 +329,36 @@ def forward_pipelined(
     )
 
     block_cfg = dataclasses.replace(cfg, use_ring_attention=False)
+    use_sp = bool(
+        cfg.use_ring_attention
+        and cfg.sp_axis
+        and cfg.sp_axis in mesh.axis_names
+    )
+    if use_sp:
+        sp_size = mesh.shape[cfg.sp_axis]
+        assert T % sp_size == 0, (
+            f"sequence length {T} not divisible by the sp axis size "
+            f"{sp_size}"
+        )
+    x_tail_spec = (cfg.sp_axis, None) if use_sp else None
 
     def stage_fn(stage_local, x_mb):
+        ring_inner = None
+        if use_sp:
+            t_local = x_mb.shape[1]
+            ring_inner = {
+                "sp_axis": cfg.sp_axis,
+                "num_blocks": mesh.shape[cfg.sp_axis],
+                "pos_offset": jax.lax.axis_index(cfg.sp_axis) * t_local,
+            }
+
         # stage_local leaves: (layers_per_stage, ...) — scan the blocks
         def step(carry, layer):
-            return _apply_block(carry, layer, block_cfg, None), None
+            return (
+                _apply_block(carry, layer, block_cfg, None,
+                             ring_inner=ring_inner),
+                None,
+            )
 
         if cfg.remat:  # the long-context memory lever applies per block
             step = jax.checkpoint(step)
@@ -320,6 +371,7 @@ def forward_pipelined(
         pp_axis=cfg.pp_axis,
         dp_axis=cfg.dp_axis,
         num_microbatches=num_microbatches,
+        x_tail_spec=x_tail_spec,
     )
     x = _rmsnorm(x, params["final_norm"])
     return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)

@@ -42,6 +42,7 @@ def pipeline_apply(
     pp_axis: str = "pp",
     dp_axis: str | None = "dp",
     num_microbatches: int,
+    x_tail_spec: tuple | None = None,
 ) -> Array:
     """Run ``x`` through ``S = mesh.shape[pp_axis]`` pipelined stages.
 
@@ -69,7 +70,12 @@ def pipeline_apply(
     assert B % (M * dp) == 0, (B, M, dp)
 
     param_specs = jax.tree.map(lambda _: P(pp_axis), stage_params)
-    x_spec = P(*((dp_axis,) + (None,) * (x.ndim - 1)))
+    # x_tail_spec shards the non-batch dims (e.g. (sp_axis, None) to keep
+    # the sequence dim sp-sharded through the pipeline for ring attention)
+    if x_tail_spec is None:
+        x_tail_spec = (None,) * (x.ndim - 1)
+    assert len(x_tail_spec) == x.ndim - 1, (x_tail_spec, x.ndim)
+    x_spec = P(*((dp_axis,) + tuple(x_tail_spec)))
 
     def body(local_params, x_full):
         # local_params leaves: (1, ...) — this stage's block

@@ -62,6 +62,62 @@ def _block_attention_update(q, k, v, scores_mask, m, l, o, scale):
     return m_new, l_new, o_new
 
 
+def ring_attention_inner(
+    q_blk: Array,
+    k_blk: Array,
+    v_blk: Array,
+    *,
+    sp_axis: str,
+    num_blocks: int,
+    causal: bool = True,
+) -> Array:
+    """The ring schedule on LOCAL (B, T_local, H_local, D) blocks.
+
+    Call this inside an *enclosing* ``shard_map`` whose mesh carries
+    ``sp_axis`` (shard_maps don't nest) — e.g. from a pipeline stage body
+    (:mod:`.pipeline`).  ``num_blocks`` must be the static ``sp`` size.
+    """
+    # (B_local, T_local, H, D) → (B, H, T, D)
+    qh = jnp.moveaxis(q_blk, 2, 1)
+    kh = jnp.moveaxis(k_blk, 2, 1)
+    vh = jnp.moveaxis(v_blk, 2, 1)
+    B, H, T, D = qh.shape
+    scale = 1.0 / (D**0.5)
+    my = jax.lax.axis_index(sp_axis)
+
+    m = jnp.full((B, H, T), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, T), jnp.float32)
+    o = jnp.zeros((B, H, T, D), jnp.float32)
+
+    tri = jnp.tril(jnp.ones((T, T), bool))
+    full = jnp.ones((T, T), bool)
+    none = jnp.zeros((T, T), bool)
+
+    def step(j, carry):
+        m, l, o, kh, vh = carry
+        src = (my - j) % num_blocks
+        if causal:
+            mask = jnp.where(src == my, tri, jnp.where(src < my, full, none))
+        else:
+            mask = full
+        m, l, o = _block_attention_update(qh, kh, vh, mask, m, l, o, scale)
+        if j < num_blocks - 1:  # final rotation's result is never read
+            perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+            kh = jax.lax.ppermute(kh, sp_axis, perm)
+            vh = jax.lax.ppermute(vh, sp_axis, perm)
+        return m, l, o, kh, vh
+
+    # unrolled python loop: num_blocks is static and small; lets XLA
+    # pipeline each step's compute with the next ppermute
+    carry = (m, l, o, kh, vh)
+    for j in range(num_blocks):
+        carry = step(j, carry)
+    m, l, o, _, _ = carry
+
+    out = (o / jnp.maximum(l[..., None], 1e-30)).astype(q_blk.dtype)
+    return jnp.moveaxis(out, 1, 2)  # back to (B, T, H, D)
+
+
 def ring_attention(
     q: Array,
     k: Array,
@@ -81,52 +137,15 @@ def ring_attention(
     same-shaped output, same sharding.
     """
     num_blocks = mesh.shape[sp_axis]
-    scale = 1.0 / (q.shape[-1] ** 0.5)
 
     lead = (dp_axis,) if dp_axis else (None,)
     spec = P(*lead, sp_axis, tp_axis, None)
 
     def body(q_blk, k_blk, v_blk):
-        # (B_local, T_local, H, D) → (B, H, T, D)
-        qh = jnp.moveaxis(q_blk, 2, 1)
-        kh = jnp.moveaxis(k_blk, 2, 1)
-        vh = jnp.moveaxis(v_blk, 2, 1)
-        B, H, T, D = qh.shape
-        my = jax.lax.axis_index(sp_axis)
-
-        m = jnp.full((B, H, T), -jnp.inf, jnp.float32)
-        l = jnp.zeros((B, H, T), jnp.float32)
-        o = jnp.zeros((B, H, T, D), jnp.float32)
-
-        tri = jnp.tril(jnp.ones((T, T), bool))
-        full = jnp.ones((T, T), bool)
-        none = jnp.zeros((T, T), bool)
-
-        def step(j, carry):
-            m, l, o, kh, vh = carry
-            src = (my - j) % num_blocks
-            if causal:
-                mask = jnp.where(
-                    src == my, tri, jnp.where(src < my, full, none)
-                )
-            else:
-                mask = full
-            m, l, o = _block_attention_update(qh, kh, vh, mask, m, l, o, scale)
-            if j < num_blocks - 1:  # final rotation's result is never read
-                perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
-                kh = jax.lax.ppermute(kh, sp_axis, perm)
-                vh = jax.lax.ppermute(vh, sp_axis, perm)
-            return m, l, o, kh, vh
-
-        # unrolled python loop: num_blocks is static and small; lets XLA
-        # pipeline each step's compute with the next ppermute
-        carry = (m, l, o, kh, vh)
-        for j in range(num_blocks):
-            carry = step(j, carry)
-        m, l, o, _, _ = carry
-
-        out = (o / jnp.maximum(l[..., None], 1e-30)).astype(q_blk.dtype)
-        return jnp.moveaxis(out, 1, 2)  # back to (B, T, H, D)
+        return ring_attention_inner(
+            q_blk, k_blk, v_blk,
+            sp_axis=sp_axis, num_blocks=num_blocks, causal=causal,
+        )
 
     return shard_map(
         body,
