@@ -144,11 +144,13 @@ def stack_stage_params(
     """Group a list of per-layer param pytrees into ``num_stages`` stacked
     stage pytrees: leaves gain leading dims (num_stages, layers_per_stage).
 
-    With ``mesh``, the stacked leaves are placed ``P(pp_axis, ...)`` so
-    steady-state parameter memory is stage-sharded (each chip holds only
-    its layers).  The stacking itself transiently materialises the full
-    stack on the source device — for models too large even for that,
-    build per-stage params directly on their shards (future round).
+    With ``mesh``, each *concrete* (eager/init-time) leaf is built
+    shard-by-shard via ``jax.make_array_from_callback`` onto
+    ``P(pp_axis, ...)`` — a device never materialises more than its own
+    stage's layers, so the layer stack can exceed one chip's memory.
+    Under a jit trace the host path can't run; leaves are stacked and
+    sharding-constrained instead, and GSPMD decides the transient — for
+    stacks that can't fit replicated, stack eagerly before jit.
 
     ``block_fn`` then scans its stage's (layers_per_stage, ...) leaves.
     """
@@ -160,15 +162,54 @@ def stack_stage_params(
         stacked = jnp.stack(leaves)  # (n, ...)
         return stacked.reshape((num_stages, per) + stacked.shape[1:])
 
-    out = jax.tree.map(stack, *layer_params_list)
-    if mesh is not None:
-        out = jax.tree.map(
-            lambda l: jax.device_put(
-                l, jax.NamedSharding(mesh, P(pp_axis))
-            ),
-            out,
+    if mesh is None:
+        return jax.tree.map(stack, *layer_params_list)
+
+    traced = any(
+        isinstance(l, jax.core.Tracer)
+        for l in jax.tree.leaves(layer_params_list)
+    )
+    if traced:
+        # under jit the host shard-by-shard path can't run; stack and let
+        # GSPMD place the result via a sharding constraint
+        def stack_constrained(*leaves):
+            out = stack(*leaves)
+            return jax.lax.with_sharding_constraint(
+                out,
+                jax.NamedSharding(mesh, P(pp_axis, *([None] * (out.ndim - 1)))),
+            )
+
+        return jax.tree.map(stack_constrained, *layer_params_list)
+
+    import numpy as np
+
+    def stack_sharded(*leaves):
+        # host views of the per-layer leaves; each device's callback
+        # assembles only the rows (stages) its shard owns
+        host = [np.asarray(l) for l in leaves]
+        shape = (num_stages, per) + host[0].shape
+        sharding = jax.NamedSharding(
+            mesh, P(pp_axis, *([None] * (len(shape) - 1)))
         )
-    return out
+        blocks = {}  # memoize per index: replica devices (dp) share blocks
+
+        def cb(index):
+            key = tuple(
+                (sl.start, sl.stop, sl.step) if isinstance(sl, slice) else sl
+                for sl in index
+            )
+            if key not in blocks:
+                lo, hi, _ = index[0].indices(num_stages)
+                block = np.stack(
+                    [host[s * per + j]
+                     for s in range(lo, hi) for j in range(per)]
+                ).reshape((hi - lo, per) + host[0].shape)
+                blocks[key] = block[(slice(None),) + tuple(index[1:])]
+            return blocks[key]
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+    return jax.tree.map(stack_sharded, *layer_params_list)
 
 
 __all__ = ["pipeline_apply", "stack_stage_params"]

@@ -94,3 +94,28 @@ def test_pipeline_schedule_sweep(S, M):
     for s in range(S):
         want = block(stage_w[s], want)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_stack_stage_params_sharded_matches_unsharded():
+    """Shard-by-shard stage stacking == plain stacking, placed P(pp)."""
+    from flink_parameter_server_tpu.parallel.pipeline import (
+        stack_stage_params,
+    )
+
+    mesh = make_mesh(2, 4, axis_names=("dp", "pp"))
+    rng = np.random.default_rng(0)
+    layers = [
+        {"w": jnp.asarray(rng.normal(0, 1, (3, 5)).astype(np.float32)),
+         "b": jnp.asarray(rng.normal(0, 1, (5,)).astype(np.float32))}
+        for _ in range(8)
+    ]
+    plain = stack_stage_params(layers, 4)
+    sharded = stack_stage_params(layers, 4, mesh=mesh)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        plain,
+        sharded,
+    )
+    assert "pp" in str(sharded["w"].sharding.spec)
