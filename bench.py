@@ -130,8 +130,9 @@ def tpu_updates_per_sec(
         mesh = make_mesh(ps_parallelism=ps)  # dp absorbs the rest
         batch = batch * mesh.shape["dp"]  # scale work with dp
 
+    # lr matches cpu_per_record_baseline (both sides numerically stable).
     logic = OnlineMatrixFactorization(
-        num_users, dim, updater=SGDUpdater(0.05), dtype=dtype, mesh=mesh
+        num_users, dim, updater=SGDUpdater(0.01), dtype=dtype, mesh=mesh
     )
     store = ShardedParamStore.create(
         num_items, (dim,), dtype=dtype,
@@ -176,14 +177,58 @@ def tpu_updates_per_sec(
         jax.block_until_ready(table)
         lats.append(time.perf_counter() - t1)
     p50_ms = float(np.percentile(np.array(lats), 50) * 1e3)
-    return updates_per_sec / n_chips, p50_ms, jnp.dtype(dtype).name, batch
+
+    # HBM traffic model for the gather/scatter-bound MF step (the honest
+    # perf yardstick for a bandwidth-bound workload): per step each side
+    # (user state table, item store) does a batch-row gather (1 read) and
+    # a batch-row scatter RMW (1 read + 1 write) → 6 row-traversals.
+    el = jnp.dtype(dtype).itemsize
+    hbm_bytes_per_step = 6 * batch * dim * el
+    step_time = dt / bench_steps
+    peak = _hbm_peak_bytes_per_sec()
+    bandwidth_util = (
+        (hbm_bytes_per_step / n_chips) / step_time / peak if peak else None
+    )
+    return {
+        "updates_per_sec_per_chip": updates_per_sec / n_chips,
+        "p50_ms": p50_ms,
+        "table_dtype": jnp.dtype(dtype).name,
+        "batch": batch,
+        "hbm_bytes_per_step": hbm_bytes_per_step,
+        "bandwidth_util": bandwidth_util,
+    }
 
 
-def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
+def _hbm_peak_bytes_per_sec():
+    """Peak HBM bandwidth for the current chip generation (None on CPU —
+    a bandwidth_util number against an unknown host memory bus would be
+    noise, not signal)."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, peak in (
+        ("v5 lite", 819e9), ("v5e", 819e9), ("v5litepod", 819e9),
+        ("v5p", 2765e9), ("v6", 1638e9), ("trillium", 1638e9),
+        ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+    ):
+        if pat in kind:
+            return peak
+    return None
+
+
+def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.01):
     """Single-node per-record PS loop: the reference's execution model
     (per-record callback, keyed store lookup, vector SGD, keyed store
     update) without JVM/Flink overheads — a *favourable* stand-in for the
-    Scala original."""
+    Scala original.
+
+    lr=0.01 keeps plain SGD numerically stable on N(0,1) ratings (at 0.05
+    the factor norms blow up and the yardstick computes inf/NaN math —
+    round-1 verdict finding).  Finiteness is returned alongside the rate;
+    main() refuses to publish a vs_baseline ratio against a diverged
+    baseline."""
     rng = np.random.default_rng(0)
     users = rng.integers(0, 5000, num_ratings)
     items = (rng.zipf(1.2, num_ratings) - 1) % 10_000
@@ -203,34 +248,46 @@ def cpu_per_record_baseline(num_ratings=20_000, dim=64, lr=0.05) -> float:
         u, i, r = users[n], items[n], ratings[n]
         p = get(user_store, u)  # worker-local state lookup
         q = get(item_store, i)  # ps.pull(i)
-        err = np.clip(r - float(p @ q), -10.0, 10.0)  # guard fp32 overflow
+        err = r - float(p @ q)
         p += lr * err * q  # local user update
         item_store[i] = q + lr * err * p  # ps.push(i, delta)
     dt = time.perf_counter() - t0
-    return num_ratings / dt
+    finite = all(
+        np.isfinite(v).all() for v in user_store.values()
+    ) and all(np.isfinite(v).all() for v in item_store.values())
+    return num_ratings / dt, finite
 
 
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
-    tpu_rate, p50_ms, table_dtype, batch = tpu_updates_per_sec()
-    cpu_rate = cpu_per_record_baseline()
+    r = tpu_updates_per_sec()
+    cpu_rate, baseline_finite = cpu_per_record_baseline()
     metric = "MF-SGD updates/sec/chip (synthetic MovieLens-like, Zipf items)"
     if fallback:
         metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    util = r["bandwidth_util"]
     print(
         json.dumps(
             {
                 "metric": metric,
-                "value": round(tpu_rate, 1),
+                "value": round(r["updates_per_sec_per_chip"], 1),
                 "unit": "updates/sec/chip",
-                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                # a diverged (non-finite) baseline is not a yardstick
+                "vs_baseline": (
+                    round(r["updates_per_sec_per_chip"] / cpu_rate, 2)
+                    if baseline_finite
+                    else None
+                ),
                 "extra": {
-                    "pull_push_p50_ms": round(p50_ms, 3),
-                    "batch": batch,
+                    "pull_push_p50_ms": round(r["p50_ms"], 3),
+                    "batch": r["batch"],
                     "per_record_baseline_updates_per_sec": round(cpu_rate, 1),
+                    "baseline_finite": baseline_finite,
                     "platform": platform,
-                    "table_dtype": table_dtype,
+                    "table_dtype": r["table_dtype"],
+                    "hbm_bytes_per_step": r["hbm_bytes_per_step"],
+                    "bandwidth_util": round(util, 4) if util else None,
                 },
             }
         )

@@ -1,0 +1,317 @@
+"""Throughput for ALL five BASELINE.md configs, single chip.
+
+BASELINE.md lists five reference configs; /bench.py covers only #1 (MF).
+This harness gives each of the others one honest number (updates/sec for
+the sparse-PS models, tokens/sec + MFU for the dense transformer):
+
+    python benchmarks/baseline_configs.py [mf|pa|w2v|fm|lm|all]
+
+Each config prints one JSON line; results are recorded in STATUS.md.
+Shapes scale by platform: TPU gets the BASELINE-shaped sizes, the CPU
+backend (1-core dev host) gets miniatures that prove the harness, not
+perf.  Robust to the wedged-tunnel failure mode the same way bench.py is
+(subprocess probe + re-exec onto CPU).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _ensure_backend_alive() -> str:
+    if os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1":
+        import jax
+
+        return jax.devices()[0].platform
+    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
+
+    alive, detail = probe_backend(
+        env_var="FPS_BENCH_INIT_TIMEOUT", default_timeout=240
+    )
+    if alive:
+        import jax
+
+        return jax.devices()[0].platform
+    print(f"baseline_configs: {detail} — re-exec on cpu", file=sys.stderr)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prior = [
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([repo, *prior])
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["FPS_BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, *sys.argv], env)
+    raise AssertionError("unreachable")
+
+
+def _is_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _row(config: str, value: float, unit: str, **extra) -> None:
+    print(
+        json.dumps(
+            {"config": config, "value": round(value, 1), "unit": unit,
+             "extra": extra},
+        ),
+        flush=True,
+    )
+
+
+def _time_steps(step, carry, batch, *, warmup=3, iters=20):
+    """Free-running step loop; returns secs/step."""
+    import jax
+
+    for _ in range(warmup):
+        carry = step(*carry, batch)
+    jax.block_until_ready(carry[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        carry = step(*carry, batch)
+    jax.block_until_ready(carry[0])
+    return (time.perf_counter() - t0) / iters
+
+
+# -- config 2: online passive-aggressive binary (streaming linear) -------
+
+
+def bench_pa():
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.core.store import zeros_init
+    from flink_parameter_server_tpu.core.transform import make_train_step
+    from flink_parameter_server_tpu.models.passive_aggressive import (
+        PassiveAggressiveBinary,
+    )
+
+    tpu = _is_tpu()
+    B = 65_536 if tpu else 8_192  # examples per microbatch
+    K = 32  # active features per example
+    F = 2_000_000 if tpu else 100_000  # feature space
+
+    store = ShardedParamStore.create(F, ())
+    logic = PassiveAggressiveBinary()
+    rng = np.random.default_rng(0)
+    batch = {
+        "ids": jnp.asarray(
+            ((rng.zipf(1.3, (B, K)) - 1) % F).astype(np.int32)
+        ),
+        "values": jnp.asarray(rng.normal(0, 1, (B, K)).astype(np.float32)),
+        "feat_mask": jnp.ones((B, K), bool),
+        "label": jnp.asarray(rng.choice([-1.0, 1.0], B).astype(np.float32)),
+        "mask": jnp.ones(B, bool),
+    }
+    step = jax.jit(make_train_step(logic, store.spec), donate_argnums=(0, 1))
+    dt = _time_steps(step, (store.table, ()), batch)
+    _row(
+        "2-passive-aggressive-binary", B / dt, "examples/sec",
+        batch=B, active_features=K, feature_space=F,
+        lane_updates_per_sec=round(B * K / dt, 1),
+    )
+
+
+# -- config 3: word2vec skip-gram with negative sampling ------------------
+
+
+def bench_w2v():
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.core.transform import make_train_step
+    from flink_parameter_server_tpu.models import word2vec
+
+    tpu = _is_tpu()
+    B = 32_768 if tpu else 4_096  # (center, context) pairs per microbatch
+    N = 5  # negatives per pair
+    V = 1_000_000 if tpu else 50_000
+    dim = 128 if tpu else 64
+
+    store = word2vec.make_store(V, dim)
+    logic = word2vec.SkipGramNS(0.025)
+    rng = np.random.default_rng(0)
+    batch = {
+        "center": jnp.asarray(((rng.zipf(1.3, B) - 1) % V).astype(np.int32)),
+        "context": jnp.asarray(((rng.zipf(1.3, B) - 1) % V).astype(np.int32)),
+        "negatives": jnp.asarray(
+            rng.integers(0, V, (B, N)).astype(np.int32)
+        ),
+        "mask": jnp.ones(B, bool),
+    }
+    step = jax.jit(make_train_step(logic, store.spec), donate_argnums=(0, 1))
+    dt = _time_steps(step, (store.table, ()), batch)
+    _row(
+        "3-word2vec-sgns", B / dt, "pairs/sec",
+        batch=B, negatives=N, vocab=V, dim=dim,
+    )
+
+
+# -- config 4: factorization machine (Criteo-shaped wide sparse table) ----
+
+
+def bench_fm(stress: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.core.transform import make_train_step
+    from flink_parameter_server_tpu.models import factorization_machine as fm
+
+    tpu = _is_tpu()
+    B = 32_768 if tpu else 4_096
+    K = 39  # Criteo: 39 features per example
+    F = (
+        33_554_432 if (tpu and stress)  # 2^25 rows — the ≥10M-row case
+        else (4_194_304 if tpu else 200_000)
+    )
+    dim = 16
+
+    cfg = fm.FMConfig(num_features=F, dim=dim, learning_rate=0.01)
+    store = fm.make_store(cfg)
+    logic = fm.FactorizationMachine(cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "ids": jnp.asarray(((rng.zipf(1.2, (B, K)) - 1) % F).astype(np.int32)),
+        "values": jnp.asarray(
+            rng.normal(0, 1, (B, K)).astype(np.float32)
+        ),
+        "feat_mask": jnp.ones((B, K), bool),
+        "label": jnp.asarray(rng.choice([-1.0, 1.0], B).astype(np.float32)),
+        "mask": jnp.ones(B, bool),
+    }
+    step = jax.jit(make_train_step(logic, store.spec), donate_argnums=(0, 1))
+    dt = _time_steps(step, (store.table, ()), batch)
+    table_gb = F * (1 + dim) * np.dtype(np.float32).itemsize / 2**30
+    _row(
+        "4-factorization-machine", B / dt, "examples/sec",
+        batch=B, features_per_example=K, table_rows=F,
+        table_gib=round(table_gb, 2), dim=dim,
+    )
+
+
+# -- config 5: transformer-base LM, dense data-parallel -------------------
+
+
+def _peak_flops_bf16():
+    import jax
+
+    if not _is_tpu():
+        return None
+    kind = jax.devices()[0].device_kind.lower()
+    for pat, peak in (
+        ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+        ("v5p", 459e12), ("v6", 918e12), ("trillium", 918e12),
+        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+    ):
+        if pat in kind:
+            return peak
+    return None
+
+
+def bench_lm():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from flink_parameter_server_tpu.core.dense import make_dense_train_step
+    from flink_parameter_server_tpu.models.transformer import (
+        TransformerConfig,
+        init_params,
+        lm_loss,
+    )
+
+    tpu = _is_tpu()
+    # transformer-base-ish on TPU; a miniature on the 1-core CPU host
+    cfg = TransformerConfig(
+        vocab_size=32_000 if tpu else 1_000,
+        d_model=512 if tpu else 64,
+        n_layers=6 if tpu else 2,
+        n_heads=8 if tpu else 4,
+        d_ff=2048 if tpu else 128,
+        max_seq=512 if tpu else 64,
+        dtype=jnp.bfloat16 if tpu else jnp.float32,
+    )
+    B = 16 if tpu else 4
+    T = cfg.max_seq
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+    step = jax.jit(
+        make_dense_train_step(lambda p, b: lm_loss(p, b, cfg), opt),
+        donate_argnums=(0, 1),
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, T)).astype(np.int32)
+        ),
+    }
+    dt = _time_steps(step, (params, opt_state), batch, warmup=2, iters=10)
+    tokens_per_sec = B * T / dt
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(params)
+    )
+    flops_per_step = 6 * n_params * B * T  # fwd+bwd dense-matmul estimate
+    peak = _peak_flops_bf16()
+    mfu = (flops_per_step / dt / peak) if peak else None
+    _row(
+        "5-transformer-lm-dense", tokens_per_sec, "tokens/sec",
+        batch=B, seq=T, n_params=n_params,
+        mfu=round(mfu, 4) if mfu else None,
+    )
+
+
+def bench_mf():
+    import bench as headline
+
+    r = headline.tpu_updates_per_sec()
+    _row(
+        "1-matrix-factorization", r["updates_per_sec_per_chip"],
+        "updates/sec/chip", batch=r["batch"],
+        pull_push_p50_ms=round(r["p50_ms"], 3),
+        table_dtype=r["table_dtype"],
+        hbm_bytes_per_step=r["hbm_bytes_per_step"],
+        bandwidth_util=(
+            round(r["bandwidth_util"], 4) if r["bandwidth_util"] else None
+        ),
+    )
+
+
+BENCHES = {
+    "mf": bench_mf,
+    "pa": bench_pa,
+    "w2v": bench_w2v,
+    "fm": bench_fm,
+    "lm": bench_lm,
+}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    platform = _ensure_backend_alive()
+    print(f"# platform: {platform}", file=sys.stderr)
+    if which == "all":
+        for name, fn in BENCHES.items():
+            fn()
+    elif which in BENCHES:
+        BENCHES[which]()
+    else:
+        raise SystemExit(f"unknown config {which!r}; use {list(BENCHES)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,9 +98,12 @@ def bench_mf(batch=16_384, dim=64):
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import tpu_updates_per_sec
 
-    rate, p50, dtype, batch = tpu_updates_per_sec(batch=batch, dim=dim)
-    print(f"mf_updates_per_sec {rate:,.0f}  p50 {p50:.3f} ms  "
-          f"dtype {dtype}  batch {batch}")
+    r = tpu_updates_per_sec(batch=batch, dim=dim)
+    print(
+        f"mf_updates_per_sec {r['updates_per_sec_per_chip']:,.0f}  "
+        f"p50 {r['p50_ms']:.3f} ms  dtype {r['table_dtype']}  "
+        f"batch {r['batch']}"
+    )
 
 
 SECTIONS = {

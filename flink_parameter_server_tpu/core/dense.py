@@ -16,17 +16,10 @@ from typing import Any, Callable, Iterable, List, Optional
 import jax
 import optax
 
-from .transform import TransformResult
+from .transform import TransformResult, jnp_copy
 
 Array = jax.Array
 PyTree = Any
-
-
-def jnp_copy(x):
-    """Device-resident copy preserving sharding (for donation safety)."""
-    import jax.numpy as jnp
-
-    return jnp.copy(x) if isinstance(x, jax.Array) else x
 
 
 class DenseParameterServer:

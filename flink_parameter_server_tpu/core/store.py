@@ -32,6 +32,7 @@ SURVEY.md §7 "Guiding translation").
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
@@ -41,6 +42,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 Array = jax.Array
 InitFn = Callable[[Array], Array]  # ids (n,) int32 -> values (n, *value_shape)
 UpdateFn = Callable[[Array, Array], Array]  # (current, combined_delta) -> new
+
+# Trace-time count of pushes where a scatter_impl="pallas" store had to
+# fall back to the XLA scatter (batch not divisible by dp).  The choice is
+# static per compiled step, so one warning per offending trace suffices —
+# a user who configured pallas must never *silently* not get it.
+_PALLAS_FALLBACKS = 0
+
+
+def pallas_fallback_count() -> int:
+    return _PALLAS_FALLBACKS
+
+
+def _note_pallas_fallback(reason: str) -> None:
+    global _PALLAS_FALLBACKS
+    _PALLAS_FALLBACKS += 1
+    warnings.warn(
+        f"scatter_impl='pallas' store falling back to XLA scatter: {reason}",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,6 +222,9 @@ def push(
                     dp_axis=dp_axis,
                     impl="pallas",
                 )
+            _note_pallas_fallback(
+                f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
+            )
         return table.at[flat_ids].add(
             flat_deltas.astype(table.dtype), mode="drop"
         )
@@ -268,6 +292,7 @@ class ShardedParamStore:
         values: Array,
         *,
         update: Union[str, UpdateFn] = "add",
+        scatter_impl: str = "xla",
         mesh: Optional[Mesh] = None,
         ps_axis: str = "ps",
     ) -> "ShardedParamStore":
@@ -279,10 +304,25 @@ class ShardedParamStore:
             value_shape=tuple(values.shape[1:]),
             dtype=values.dtype,
             update=update,
+            scatter_impl=scatter_impl,
             mesh=mesh,
             ps_axis=ps_axis,
         )
-        pad = spec.padded_capacity - spec.capacity
+        return cls(spec, cls._place(spec, values))
+
+    @classmethod
+    def from_spec_values(
+        cls, spec: StoreSpec, values: Array
+    ) -> "ShardedParamStore":
+        """Seed a store carrying the *full* target ``spec`` (update rule,
+        ``scatter_impl``, mesh layout) from an unpadded ``(capacity, ...)``
+        value array — the checkpoint-restore path, which must not drop
+        spec fields the way a shape-inferred rebuild would."""
+        return cls(spec, cls._place(spec, values.astype(spec.dtype)))
+
+    @staticmethod
+    def _place(spec: StoreSpec, values: Array) -> Array:
+        pad = spec.padded_capacity - values.shape[0]
         if pad:
             values = jnp.concatenate(
                 [values, jnp.zeros((pad,) + spec.value_shape, spec.dtype)]
@@ -290,7 +330,7 @@ class ShardedParamStore:
         sharding = spec.sharding()
         if sharding is not None:
             values = jax.device_put(values, sharding)
-        return cls(spec, values)
+        return values
 
     # -- protocol ---------------------------------------------------------
     def pull(self, ids: Array) -> Array:
@@ -324,4 +364,5 @@ __all__ = [
     "pull",
     "push",
     "zeros_init",
+    "pallas_fallback_count",
 ]

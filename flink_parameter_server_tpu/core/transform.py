@@ -23,6 +23,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
+import zlib
 from typing import Any, Callable, Generic, Iterable, List, Optional, Tuple, TypeVar, Union
 
 import jax
@@ -46,6 +47,28 @@ T = TypeVar("T")
 P_ = TypeVar("P_")
 WOut = TypeVar("WOut")
 PSOut = TypeVar("PSOut")
+
+
+def jnp_copy(x):
+    """Device-resident copy preserving sharding (for donation safety)."""
+    return jnp.copy(x) if isinstance(x, jax.Array) else x
+
+
+def stable_route_hash(key) -> int:
+    """Routing hash for ``hash(paramId) % psParallelism`` that is stable
+    across processes (Python's ``hash`` is PYTHONHASHSEED-randomised for
+    strings, which would break cross-process determinism of the event
+    backend).  Ints keep identity semantics, matching the reference's
+    ``paramId.hashCode`` for Scala Ints."""
+    if isinstance(key, bool):
+        return int(key)
+    if isinstance(key, (int, np.integer)):
+        return int(key)
+    if isinstance(key, str):
+        return zlib.crc32(key.encode("utf-8"))
+    if isinstance(key, bytes):
+        return zlib.crc32(key)
+    return zlib.crc32(repr(key).encode("utf-8"))
 
 
 @dataclasses.dataclass
@@ -178,8 +201,9 @@ class _LocalRuntime:
         return flushed
 
     def _route_server(self, param_id: int) -> int:
-        # The reference's partitionCustom(hash(paramId) % psParallelism).
-        return hash(param_id) % len(self.servers)
+        # The reference's partitionCustom(hash(paramId) % psParallelism),
+        # with a PYTHONHASHSEED-independent hash for determinism.
+        return stable_route_hash(param_id) % len(self.servers)
 
     def run(self, data: Iterable) -> None:
         it = iter(data)
@@ -317,8 +341,12 @@ def transform_batched(
     mesh = mesh or spec.mesh
 
     step = jax.jit(make_train_step(worker_logic, spec), donate_argnums=(0, 1))
+    # The jitted step donates (table, state); start from copies so the
+    # caller's store (and any restored state they still hold) stays valid
+    # — the same contract transform_dense gives (dense.py).  A fresh
+    # init_state has no outside owner, so only restored state is copied.
     state = (
-        initial_state
+        jax.tree.map(jnp_copy, initial_state)
         if initial_state is not None
         else worker_logic.init_state(rng)
     )
@@ -327,7 +355,7 @@ def transform_batched(
     if mesh is not None and dp_axis in mesh.axis_names and mesh.shape[dp_axis] > 1:
         batch_sharding = NamedSharding(mesh, PartitionSpec(dp_axis))
 
-    table = store.table
+    table = jnp_copy(store.table)
     worker_outputs: List[Any] = []
     step_idx = 0
     for batch in data:
@@ -489,7 +517,7 @@ def transform_with_model_load(
     ps_par = kwargs2.get("ps_parallelism", 1)
     servers = _instances(ps_logic, ps_par, "ps")
     for pid, value in model:
-        target = servers[hash(pid) % ps_par]
+        target = servers[stable_route_hash(pid) % ps_par]
         if isinstance(target, SimplePSLogic):
             # Model load *sets* the stored value (it is not a delta).
             target.store[pid] = value

@@ -166,8 +166,16 @@ class PABinaryWorkerLogic(WorkerLogic):
     semantics-parity tests."""
 
     def __init__(self, rule: PARule = PARule()):
+        import collections
+
         self.rule = rule
         self.pending: Dict[int, dict] = {}
+        # param_id -> FIFO of pending-example keys awaiting that answer:
+        # O(1) per pull answer instead of a linear scan over all pending
+        # examples (which goes quadratic on real streams).
+        self._waiting: Dict[int, "collections.deque"] = (
+            collections.defaultdict(collections.deque)
+        )
         self._next = 0
 
     def on_recv(self, data, ps):
@@ -180,21 +188,31 @@ class PABinaryWorkerLogic(WorkerLogic):
             "weights": {},
         }
         self.pending[self._next] = ex
-        self._next += 1
         for fid in ids:
+            self._waiting[fid].append(self._next)
             ps.pull(fid)
+        self._next += 1
 
     def on_pull_recv(self, param_id, param_value, ps):
         import numpy as np
 
         done = []
-        for key, ex in self.pending.items():
-            if param_id in ex["missing"]:
-                ex["weights"][param_id] = param_value
-                ex["missing"].discard(param_id)
-                if not ex["missing"]:
-                    done.append(key)
-                break  # one answer satisfies one outstanding pull
+        q = self._waiting.get(param_id)
+        # Answers go to the oldest example still missing this id — the
+        # same order the previous insertion-ordered scan produced.
+        while q:
+            key = q.popleft()
+            ex = self.pending.get(key)
+            if ex is None or param_id not in ex["missing"]:
+                continue  # stale entry (duplicate id within one example)
+            ex["weights"][param_id] = param_value
+            ex["missing"].discard(param_id)
+            if not ex["missing"]:
+                done.append(key)
+            break  # one answer satisfies one outstanding pull
+        if q is not None and not q:
+            # don't leak one empty deque per distinct feature id ever seen
+            del self._waiting[param_id]
         for key in done:
             ex = self.pending.pop(key)
             x = np.array([ex["values"][i] for i in ex["ids"]], np.float32)

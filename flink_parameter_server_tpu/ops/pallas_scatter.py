@@ -19,12 +19,14 @@ Algorithm (duplicate-compressing read-modify-write):
      persists across grid steps (TPU grids execute sequentially), so runs
      spanning chunk boundaries are handled for free.
 
-``scatter_add(...)`` is the public wrapper: pads/masks OOB ids to a
-sentinel row, sorts, invokes the kernel with ``input_output_aliases`` (the
-table is updated in place), and slices the sentinel back off.  On
-non-TPU backends it runs in interpreter mode (slow but exact) so the unit
-tests cover the kernel logic on the CPU mesh; ``use_pallas="auto"`` in
-callers picks the XLA path off-TPU instead.
+``scatter_add(...)`` is the public wrapper: turns OOB/masked lanes into
+zero-deltas on the last row, sorts, and invokes the kernel with
+``input_output_aliases`` (the table is updated in place when the caller's
+jit donates it; on an eager call the wrapper copies the table first so the
+functional all-mutators-return-new-stores contract holds).  On non-TPU
+backends it runs in interpreter mode (slow but exact) so the unit tests
+cover the kernel logic on the CPU mesh; ``use_pallas="auto"`` in callers
+picks the XLA path off-TPU instead.
 """
 from __future__ import annotations
 
@@ -44,7 +46,8 @@ def _kernel(ids_ref, deltas_ref, table_ref, out_ref, acc_ref, carry_ref,
 
     ids_ref: (N,) int32 in SMEM (scalar-prefetched, whole batch).
     deltas_ref: (chunk, dim) VMEM block for this grid step.
-    table_ref/out_ref: aliased (capacity+1, dim) HBM table (+sentinel row).
+    table_ref/out_ref: aliased (capacity, dim) HBM table (dropped lanes
+      arrive as zero-deltas on the last row, so no sentinel is needed).
     acc_ref: (1, dim) VMEM — the current run's partial sum.
     carry_ref: (1,) int32 SMEM — the current run's id (-1 = none).
     row_ref: (1, dim) VMEM — staging row for the HBM read-modify-write.
@@ -108,9 +111,17 @@ def sorted_scatter_add_pallas(
     chunk: int = 512, interpret: bool = False,
 ) -> Array:
     """Core kernel call: ids MUST be sorted ascending and in-range;
-    dropped lanes must carry zero deltas (they may alias any row)."""
+    dropped lanes must carry zero deltas (they may alias any row).
+
+    ``input_output_aliases`` makes the kernel update the table buffer in
+    place.  Under an enclosing jit that is donation-aware and safe; on an
+    *eager* call the caller's concrete buffer would be invalidated, so we
+    copy it first (eager pushes are the cold path — tests, notebooks)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if not isinstance(table, jax.core.Tracer):
+        table = jnp.copy(table)
 
     n, dim = sorted_deltas.shape
     capacity = table.shape[0]

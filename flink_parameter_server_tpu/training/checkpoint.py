@@ -97,11 +97,11 @@ def _payload_to_state(
         values = np.concatenate(
             [values, np.zeros((spec.capacity - values.shape[0],) + values.shape[1:], values.dtype)]
         )
-    store = ShardedParamStore.from_values(
-        jax.numpy.asarray(values, dtype=spec.dtype),
-        update=spec.update,
-        mesh=spec.mesh,
-        ps_axis=spec.ps_axis,
+    # Rebuild on the *target* spec directly so nothing is dropped in the
+    # round-trip (scatter_impl in particular: a pallas-configured store
+    # must restore as a pallas-configured store).
+    store = ShardedParamStore.from_spec_values(
+        spec, jax.numpy.asarray(values, dtype=spec.dtype)
     )
     worker_state = payload.get("worker_state")
     if worker_state_shardings is not None and worker_state is not None:
@@ -130,8 +130,9 @@ class JobCheckpointManager:
         max_to_keep: int = 2,
     ):
         ocp = _ocp()
+        self._directory = os.path.abspath(directory)
         self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=use_async,
@@ -156,20 +157,57 @@ class JobCheckpointManager:
         the buffers immediately after this call), and its per-shard
         serialization avoids a full host gather, so arrays pass straight
         through (multi-host-safe)."""
+        import shutil
+
         ocp = _ocp()
+        trash = None
         if force and step in self._mgr.all_steps():
-            # orbax raises on duplicate steps; replace (older retained
-            # steps stay durable through the delete+rewrite window)
+            # orbax raises on duplicate steps.  Replace without a
+            # durability gap: move the old step aside (atomic rename on
+            # the same filesystem — a crash between here and the new
+            # commit leaves the renamed copy on disk, never zero
+            # checkpoints), then drop it only after the new save has
+            # committed.
             self.wait()
-            self._mgr.delete(step)
-        return bool(
-            self._mgr.save(
-                step,
-                args=ocp.args.StandardSave(
-                    _make_payload(store, worker_state, step, extra)
-                ),
+            old_dir = os.path.join(self._directory, str(step))
+            trash = os.path.join(self._directory, f".replacing.{step}")
+            if os.path.isdir(old_dir):
+                shutil.rmtree(trash, ignore_errors=True)
+                os.rename(old_dir, trash)
+                self._mgr.reload()
+            else:  # non-default step-dir layout: fall back to delete
+                trash = None
+                self._mgr.delete(step)
+        accepted = False
+        try:
+            accepted = bool(
+                self._mgr.save(
+                    step,
+                    args=ocp.args.StandardSave(
+                        _make_payload(store, worker_state, step, extra)
+                    ),
+                    # orbax's save-interval policy rejects steps <=
+                    # latest; replacing a non-latest step must bypass it
+                    force=force,
+                )
             )
-        )
+        finally:
+            if trash is not None:
+                if accepted:
+                    # Block until the replacement is durable, then prune
+                    # the old copy (force saves are rare explicit "save
+                    # now" calls, so the wait is acceptable even under
+                    # async checkpointing).
+                    self.wait()
+                    shutil.rmtree(trash, ignore_errors=True)
+                else:
+                    # save rejected or raised: put the old step back —
+                    # never strand the only copy under .replacing.*
+                    os.rename(
+                        trash, os.path.join(self._directory, str(step))
+                    )
+                    self._mgr.reload()
+        return accepted
 
     def latest_step(self) -> Optional[int]:
         self.wait()

@@ -1,0 +1,71 @@
+"""Child process for the two-process jax.distributed smoke test.
+
+Launched (twice) by tests/test_multihost.py with:
+    python tests/_multihost_child.py <coordinator> <num_procs> <proc_id>
+
+Exercises the real multi-process path of parallel/multihost.py on the CPU
+backend: distributed init, global mesh construction with the ICI/DCN
+axis-layout rule, per-process batch slicing, and one cross-process psum
+through a pjit'd computation.  Prints "MULTIHOST_OK <proc_id> <sum>" on
+success; any assertion/exception exits nonzero.
+"""
+import sys
+
+# must run before jax touches a backend
+coordinator, num_procs, proc_id = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+)
+
+from flink_parameter_server_tpu.parallel import multihost  # noqa: E402
+
+assert multihost.initialize(
+    coordinator_address=coordinator,
+    num_processes=num_procs,
+    process_id=proc_id,
+)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec  # noqa: E402
+
+assert jax.process_count() == num_procs, jax.process_count()
+assert jax.process_index() == proc_id, jax.process_index()
+
+local = jax.local_device_count()
+total = len(jax.devices())
+assert total == num_procs * local, (total, local)
+
+# ps inside a host (ICI analogue), dp across hosts (DCN analogue)
+mesh = multihost.make_multihost_mesh(ps=local)
+assert mesh.shape["dp"] == num_procs and mesh.shape["ps"] == local
+
+# per-process ingestion slice: disjoint, covering
+sl = multihost.process_local_batch_slice(8 * num_procs)
+assert sl == slice(proc_id * 8, (proc_id + 1) * 8), sl
+
+# one real cross-process collective: global sum of a dp-sharded array.
+# Each process materialises only its addressable shard (multi-host rule:
+# never device_put to a non-addressable device).
+global_shape = (num_procs * local, 4)
+sharding = NamedSharding(mesh, PartitionSpec(("dp", "ps"), None))
+arrays = [
+    jax.device_put(
+        np.full((1, 4), float(d.id), np.float32), d
+    )
+    for d in sharding.addressable_devices_indices_map(global_shape)
+]
+x = jax.make_array_from_single_device_arrays(
+    global_shape, sharding, arrays
+)
+total_sum = jax.jit(
+    lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, PartitionSpec())
+)(x)
+# device ids are process-offset on multi-process CPU; derive the expected
+# global sum from the actual ids (still proves both processes' shards
+# were reduced — each process only wrote its own devices' values)
+expected = sum(d.id for d in jax.devices()) * 4.0
+got = float(np.asarray(total_sum))
+assert got == expected, (got, expected)
+
+print(f"MULTIHOST_OK {proc_id} {got}", flush=True)

@@ -92,14 +92,16 @@ def test_bench_multichip_path(monkeypatch):
     import bench
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    rate, p50, dtype_name, batch = bench.tpu_updates_per_sec(
+    r = bench.tpu_updates_per_sec(
         num_users=64, num_items=128, dim=8, batch=16,
         warmup_steps=1, bench_steps=2, dtype=jnp.float32,
     )
     # batch scales by dp under the same ps-selection rule the bench uses
     ps = next((c for c in (4, 2) if n % c == 0), 1)
-    assert batch == 16 * (n // ps)
-    assert rate > 0 and p50 > 0 and dtype_name == "float32"
+    assert r["batch"] == 16 * (n // ps)
+    assert r["updates_per_sec_per_chip"] > 0 and r["p50_ms"] > 0
+    assert r["table_dtype"] == "float32"
+    assert r["hbm_bytes_per_step"] > 0
 
 
 def test_backend_probe_timeout_and_cache(monkeypatch):
