@@ -29,40 +29,15 @@ import numpy as np
 
 
 def _ensure_backend_alive() -> str:
-    """Return the backend platform, re-execing onto CPU if init wedges.
-
-    The probe runs in a *subprocess*: a wedged PJRT client init blocks in
-    C++ with the GIL held, so in-process SIGALRM handlers never fire."""
-    if os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1":
-        import jax
-
-        return jax.devices()[0].platform
-
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
-
-    alive, detail = probe_backend(
-        env_var="FPS_BENCH_INIT_TIMEOUT", default_timeout=240
-    )
-    if alive:
-        import jax
-
-        return jax.devices()[0].platform
-    print(f"bench: {detail} — re-exec on cpu", file=sys.stderr, flush=True)
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    """Return the backend platform, re-execing onto CPU if init wedges
+    (subprocess probe + env scrub — one shared recipe in backend_probe)."""
     repo_dir = os.path.dirname(os.path.abspath(__file__))
-    # prepend (don't clobber) so user site paths survive; the TPU-dialing
-    # sitecustomize dir is dropped by resetting only known-poison entries
-    prior = [
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p
-    ]
-    env["PYTHONPATH"] = os.pathsep.join([repo_dir, *prior])
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["FPS_BENCH_CPU_FALLBACK"] = "1"
-    os.execve(sys.executable, [sys.executable, __file__], env)
-    raise AssertionError("unreachable")
+    sys.path.insert(0, repo_dir)
+    from flink_parameter_server_tpu.utils.backend_probe import (
+        ensure_backend_or_cpu_reexec,
+    )
+
+    return ensure_backend_or_cpu_reexec(repo_dir=repo_dir)
 
 
 def tpu_updates_per_sec(

@@ -27,32 +27,13 @@ sys.path.insert(
 
 
 def _ensure_backend_alive() -> str:
-    if os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1":
-        import jax
-
-        return jax.devices()[0].platform
-    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
-
-    alive, detail = probe_backend(
-        env_var="FPS_BENCH_INIT_TIMEOUT", default_timeout=240
+    from flink_parameter_server_tpu.utils.backend_probe import (
+        ensure_backend_or_cpu_reexec,
     )
-    if alive:
-        import jax
 
-        return jax.devices()[0].platform
-    print(f"baseline_configs: {detail} — re-exec on cpu", file=sys.stderr)
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    prior = [
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p
-    ]
-    env["PYTHONPATH"] = os.pathsep.join([repo, *prior])
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["FPS_BENCH_CPU_FALLBACK"] = "1"
-    os.execve(sys.executable, [sys.executable, *sys.argv], env)
-    raise AssertionError("unreachable")
+    return ensure_backend_or_cpu_reexec(
+        repo_dir=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
 
 
 def _is_tpu() -> bool:
@@ -72,15 +53,17 @@ def _row(config: str, value: float, unit: str, **extra) -> None:
 
 
 def _time_steps(step, carry, batch, *, warmup=3, iters=20):
-    """Free-running step loop; returns secs/step."""
+    """Free-running step loop; returns secs/step.  ``step`` returns
+    ``(*new_carry, per_step_output)``."""
     import jax
 
+    carry = list(carry)
     for _ in range(warmup):
-        carry = step(*carry, batch)
+        *carry, _out = step(*carry, batch)
     jax.block_until_ready(carry[0])
     t0 = time.perf_counter()
     for _ in range(iters):
-        carry = step(*carry, batch)
+        *carry, _out = step(*carry, batch)
     jax.block_until_ready(carry[0])
     return (time.perf_counter() - t0) / iters
 

@@ -76,6 +76,8 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
         mesh: Optional[Mesh] = None,
         dp_axis: str = DP_AXIS,
         dtype=jnp.float32,
+        dedup_scale: bool = False,
+        num_items: Optional[int] = None,
     ):
         self.num_users = num_users
         self.dim = dim
@@ -86,6 +88,15 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
         self.mesh = mesh
         self.dp_axis = dp_axis
         self.dtype = dtype
+        # dedup_scale: combine duplicate-id deltas within a batch by MEAN
+        # instead of SUM (ops/dedup.py).  At very large microbatches a
+        # Zipf-hot user/item otherwise takes count x lr effective steps
+        # from one pulled snapshot and SGD diverges; mean-combining keeps
+        # the step bounded regardless of batch size (staleness knob).
+        self.dedup_scale = dedup_scale
+        self.num_items = num_items
+        if dedup_scale and num_items is None:
+            raise ValueError("dedup_scale=True requires num_items")
 
     # -- BatchedWorkerLogic ------------------------------------------------
     def init_state(self, rng: Array) -> Array:
@@ -113,6 +124,15 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
         user_delta, item_delta, pred = self.updater.delta(
             ratings, user_vecs, pulled
         )
+        if self.dedup_scale:
+            from ..ops.dedup import occurrence_scale
+
+            u_scale = occurrence_scale(users, self.num_users, mask)
+            i_scale = occurrence_scale(
+                batch["item"].astype(jnp.int32), self.num_items, mask
+            )
+            user_delta = user_delta * u_scale[..., None].astype(self.dtype)
+            item_delta = item_delta * i_scale[..., None].astype(self.dtype)
         m = mask[..., None].astype(self.dtype)
         state = state.at[users].add(user_delta * m, mode="drop")
         out = {"prediction": pred, "error": (ratings - pred) * mask}
@@ -134,6 +154,7 @@ def ps_online_mf(
     regularization: float = 0.0,
     seed: int = 0,
     mesh: Optional[Mesh] = None,
+    dedup_scale: bool = False,
     **transform_kwargs,
 ):
     """End-to-end wrapper mirroring ``PSOnlineMatrixFactorization.psOnlineMF``
@@ -151,6 +172,8 @@ def ps_online_mf(
         updater=SGDUpdater(learning_rate, regularization),
         seed=seed,
         mesh=mesh,
+        dedup_scale=dedup_scale,
+        num_items=num_items if dedup_scale else None,
     )
     store = ShardedParamStore.create(
         num_items,

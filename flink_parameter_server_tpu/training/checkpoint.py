@@ -179,6 +179,7 @@ class JobCheckpointManager:
                 trash = None
                 self._mgr.delete(step)
         accepted = False
+        committed = False
         try:
             accepted = bool(
                 self._mgr.save(
@@ -191,23 +192,48 @@ class JobCheckpointManager:
                     force=force,
                 )
             )
+            if accepted and trash is not None:
+                # Block until the replacement is durable (force saves are
+                # rare explicit "save now" calls, so the wait is
+                # acceptable even under async checkpointing — and an
+                # async-write failure surfaces HERE, while the old copy
+                # is still restorable, not after we pruned it).
+                self.wait()
+                committed = True
         finally:
             if trash is not None:
-                if accepted:
-                    # Block until the replacement is durable, then prune
-                    # the old copy (force saves are rare explicit "save
-                    # now" calls, so the wait is acceptable even under
-                    # async checkpointing).
-                    self.wait()
+                if committed:
                     shutil.rmtree(trash, ignore_errors=True)
                 else:
-                    # save rejected or raised: put the old step back —
-                    # never strand the only copy under .replacing.*
-                    os.rename(
-                        trash, os.path.join(self._directory, str(step))
-                    )
-                    self._mgr.reload()
+                    self._restore_replaced(step, trash)
         return accepted
+
+    def _restore_replaced(self, step: int, trash: str) -> None:
+        """Put a renamed-aside step back after a failed replacement.
+
+        Runs in a ``finally`` — it must not raise (it would mask the
+        original save error), and it must clear any partial new step dir
+        that would make the rename fail with ENOTEMPTY.  If the restore
+        itself fails, the old copy stays intact under ``trash`` and we
+        warn with the path so it is recoverable by hand."""
+        import shutil
+        import warnings
+
+        old_dir = os.path.join(self._directory, str(step))
+        try:
+            if os.path.exists(old_dir):
+                # failed/uncommitted replacement remnants — remove so the
+                # known-good copy can take the slot back
+                shutil.rmtree(old_dir, ignore_errors=True)
+            os.rename(trash, old_dir)
+            self._mgr.reload()
+        except OSError as e:  # pragma: no cover - disk-level failures
+            warnings.warn(
+                f"checkpoint step {step}: replacement failed and the "
+                f"previous copy could not be moved back ({e}); it is "
+                f"preserved at {trash}",
+                RuntimeWarning,
+            )
 
     def latest_step(self) -> Optional[int]:
         self.wait()

@@ -36,16 +36,11 @@ def test_two_process_distributed_smoke():
     port = _free_port()
     coordinator = f"127.0.0.1:{port}"
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    from flink_parameter_server_tpu.utils.backend_probe import scrub_axon_env
+
+    env = scrub_axon_env(pythonpath_prepend=(repo,))
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     env["JAX_ENABLE_X64"] = "0"
-    prior = [
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and ".axon_site" not in p
-    ]
-    env["PYTHONPATH"] = os.pathsep.join([repo, *prior])
-    env.pop("PALLAS_AXON_POOL_IPS", None)
 
     procs = [
         subprocess.Popen(

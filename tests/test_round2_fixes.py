@@ -146,6 +146,59 @@ def test_sharded_pallas_fallback_is_observable(mesh):
     assert store_mod.pallas_fallback_count() == n0 + 1
 
 
+def test_mf_dedup_scale_means_duplicate_updates():
+    """With dedup_scale, k identical (user,item) records in one batch move
+    the factors by ONE averaged step, not k summed steps."""
+    import jax
+
+    def run(dedup):
+        logic = OnlineMatrixFactorization(
+            4, 4, updater=SGDUpdater(0.1), dedup_scale=dedup,
+            num_items=8 if dedup else None,
+        )
+        store = ShardedParamStore.create(
+            8, (4,), init_fn=ranged_random_factor(1, (4,))
+        )
+        batch = {
+            "user": jnp.zeros(4, jnp.int32),
+            "item": jnp.full(4, 3, jnp.int32),
+            "rating": jnp.ones(4),
+            "mask": jnp.ones(4, bool),
+        }
+        res = transform_batched([batch], logic, store)
+        return (
+            np.asarray(res.worker_state),
+            np.asarray(res.store.values()),
+            store,
+        )
+
+    u_sum, i_sum, store0 = run(False)
+    u_mean, i_mean, _ = run(True)
+    base_i = np.asarray(store0.values())
+    logic1 = OnlineMatrixFactorization(4, 4, updater=SGDUpdater(0.1))
+    store1 = ShardedParamStore.create(
+        8, (4,), init_fn=ranged_random_factor(1, (4,))
+    )
+    one = {
+        "user": jnp.zeros(1, jnp.int32),
+        "item": jnp.full(1, 3, jnp.int32),
+        "rating": jnp.ones(1),
+        "mask": jnp.ones(1, bool),
+    }
+    res1 = transform_batched([one], logic1, store1)
+    # mean-combined quadruplicate == one single-record step
+    np.testing.assert_allclose(
+        i_mean[3], np.asarray(res1.store.values())[3], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        u_mean[0], np.asarray(res1.worker_state)[0], rtol=1e-5
+    )
+    # and the sum path moved 4x as far from the start
+    np.testing.assert_allclose(
+        i_sum[3] - base_i[3], 4.0 * (i_mean[3] - base_i[3]), rtol=1e-4
+    )
+
+
 def test_pa_event_duplicate_feature_ids():
     """Duplicate feature ids within one example must still complete the
     countdown under the O(1) per-answer waiting index."""
