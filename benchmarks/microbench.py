@@ -10,10 +10,15 @@ Each section prints `name value unit` lines.
 """
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
 
 
 def _timeit(fn, *args, warmup=2, iters=10):
@@ -29,29 +34,51 @@ def _timeit(fn, *args, warmup=2, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_scatter(capacity=131_072, dim=64, batch=16_384, zipf=1.2):
-    """XLA scatter-add vs the Pallas sorted-run kernel under skew."""
+def bench_scatter(capacity=131_072, dim=64, batch=16_384):
+    """XLA scatter-add vs the Pallas sorted-run kernel under skew.
+
+    On TPU this is the `chunk`-tuning run the scatter_impl default hangs
+    on: a skew (zipf) x chunk sweep, one line each, bf16 and fp32."""
     import jax
     import jax.numpy as jnp
 
     from flink_parameter_server_tpu.ops.pallas_scatter import scatter_add
 
     rng = np.random.default_rng(0)
-    table = jnp.zeros((capacity, dim), jnp.float32)
-    ids = jnp.asarray(((rng.zipf(zipf, batch) - 1) % capacity).astype(np.int32))
-    deltas = jnp.asarray(rng.normal(0, 1, (batch, dim)).astype(np.float32))
+    for dtype in (jnp.float32, jnp.bfloat16):
+        dname = jnp.dtype(dtype).name
+        table = jnp.zeros((capacity, dim), dtype)
+        for zipf in (1.1, 1.2, 1.5):
+            ids = jnp.asarray(
+                ((rng.zipf(zipf, batch) - 1) % capacity).astype(np.int32)
+            )
+            deltas = jnp.asarray(
+                rng.normal(0, 1, (batch, dim)).astype(np.float32)
+            )
+            uniq = len(np.unique(np.asarray(ids)))
 
-    xla = jax.jit(lambda t, i, d: t.at[i].add(d))
-    t_xla = _timeit(xla, table, ids, deltas)
-    print(f"scatter_xla {t_xla*1e3:.3f} ms/op")
+            xla = jax.jit(lambda t, i, d: t.at[i].add(d.astype(t.dtype)))
+            t_xla = _timeit(xla, table, ids, deltas)
+            print(
+                f"scatter_xla[{dname},zipf={zipf}] {t_xla*1e3:.3f} ms/op "
+                f"(unique {uniq}/{batch})"
+            )
 
-    if jax.default_backend() == "tpu":
-        pl = jax.jit(lambda t, i, d: scatter_add(t, i, d, interpret=False))
-        t_pl = _timeit(pl, table, ids, deltas)
-        uniq = len(np.unique(np.asarray(ids)))
-        print(f"scatter_pallas {t_pl*1e3:.3f} ms/op (unique ids {uniq}/{batch})")
-    else:
-        print("scatter_pallas skipped (interpret mode is not a perf number)")
+            if jax.default_backend() != "tpu":
+                continue  # interpret mode is not a perf number
+            for chunk in (256, 512, 1024, 2048):
+                pl = jax.jit(
+                    lambda t, i, d, c=chunk: scatter_add(
+                        t, i, d, chunk=c, interpret=False
+                    )
+                )
+                t_pl = _timeit(pl, table, ids, deltas)
+                print(
+                    f"scatter_pallas[{dname},zipf={zipf},chunk={chunk}] "
+                    f"{t_pl*1e3:.3f} ms/op"
+                )
+    if jax.default_backend() != "tpu":
+        print("scatter_pallas skipped (no TPU)")
 
 
 def bench_topk(rows=131_072, dim=64, batch=64, k=100):
@@ -106,11 +133,64 @@ def bench_mf(batch=16_384, dim=64):
     )
 
 
+def bench_mf_fused(capacity=131_072, num_users=100_000, dim=64,
+                   batch=16_384, zipf=1.2):
+    """Fused pull+SGD+push kernel vs the unfused XLA step (TPU only —
+    interpret mode is not a perf number)."""
+    import jax
+    import jax.numpy as jnp
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.core.transform import make_train_step
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.ops.pallas_mf import (
+        make_fused_mf_train_step,
+    )
+    from flink_parameter_server_tpu.utils.initializers import normal_factor
+
+    if jax.default_backend() != "tpu":
+        print("mf_fused skipped (no TPU)")
+        return
+    rng = np.random.default_rng(0)
+    logic = OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(0.01)
+    )
+    store = ShardedParamStore.create(
+        capacity, (dim,), init_fn=normal_factor(1, (dim,))
+    )
+    users0 = logic.init_state(jax.random.PRNGKey(0))
+    batch_d = {
+        "user": jnp.asarray(
+            rng.integers(0, num_users, batch).astype(np.int32)
+        ),
+        "item": jnp.asarray(
+            ((rng.zipf(zipf, batch) - 1) % capacity).astype(np.int32)
+        ),
+        "rating": jnp.asarray(rng.normal(0, 1, batch).astype(np.float32)),
+        "mask": jnp.ones(batch, bool),
+    }
+    unfused = jax.jit(make_train_step(logic, store.spec))
+    t_u = _timeit(unfused, store.table, users0, batch_d)
+    print(f"mf_step_unfused {t_u*1e3:.3f} ms/step (batch {batch})")
+    for chunk in (512, 1024, 2048):
+        fused = jax.jit(
+            make_fused_mf_train_step(
+                learning_rate=0.01, chunk=chunk, interpret=False
+            )
+        )
+        t_f = _timeit(fused, store.table, users0, batch_d)
+        print(f"mf_step_fused[chunk={chunk}] {t_f*1e3:.3f} ms/step")
+
+
 SECTIONS = {
     "scatter": bench_scatter,
     "topk": bench_topk,
     "ring": bench_ring,
     "mf": bench_mf,
+    "mf_fused": bench_mf_fused,
 }
 
 if __name__ == "__main__":

@@ -1,0 +1,156 @@
+"""One-command on-chip measurement battery (run the moment a TPU is live).
+
+The dev-host tunnel has been dead since round 1; every on-chip proof
+obligation is queued behind it.  This orchestrator runs them all in
+priority order with per-job time budgets, saving raw output under
+``results/tpu/``, so even a short tunnel window yields the full evidence
+set:
+
+  1. bench.py batch sweep (16k / 64k / 256k, bf16)   — headline metric
+  2. microbench scatter                               — pallas-vs-XLA chunk tuning
+  3. criteo_stress (2^24-row bf16 store)              — wide-table proof
+  4. baseline_configs all                             — five-config table
+  5. MF step profiler trace                           — fused-kernel decision
+
+    python benchmarks/tpu_day1.py [--quick]
+
+Each job runs in a SUBPROCESS with a timeout (a mid-battery tunnel death
+must not wedge the orchestrator); results and a summary land in
+results/tpu/.  Exits nonzero if the probe says no TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "results", "tpu")
+
+
+def run_job(name, argv, timeout, out_dir, env=None):
+    path = os.path.join(out_dir, f"{name}.out")
+    t0 = time.time()
+    status = "ok"
+    try:
+        with open(path, "w") as f:
+            rc = subprocess.call(
+                argv, stdout=f, stderr=subprocess.STDOUT, timeout=timeout,
+                env=env, cwd=REPO,
+            )
+        if rc != 0:
+            status = f"exit={rc}"
+    except subprocess.TimeoutExpired:
+        status = f"timeout>{timeout}s"
+    dt = round(time.time() - t0, 1)
+    print(f"[{name}] {status} in {dt}s -> {path}", flush=True)
+    return {"job": name, "status": status, "secs": dt, "output": path}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="halve budgets / shrink shapes (short tunnel windows)",
+    )
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
+
+    alive, detail = probe_backend(timeout=args.probe_timeout)
+    if not alive:
+        print(f"no live TPU: {detail}", file=sys.stderr)
+        return 2
+    os.makedirs(OUT_DIR, exist_ok=True)
+    scale = 0.5 if args.quick else 1.0
+    py = sys.executable
+    results = []
+
+    # 1. headline bench, bf16, batch sweep
+    for batch in (16_384, 65_536, 262_144):
+        env = dict(os.environ)
+        env["FPS_BENCH_BATCH"] = str(batch)
+        env["FPS_BENCH_DTYPE"] = "bfloat16"
+        results.append(
+            run_job(
+                f"bench_b{batch}", [py, os.path.join(REPO, "bench.py")],
+                int(600 * scale), OUT_DIR, env=env,
+            )
+        )
+        if args.quick:
+            break  # one batch size is enough for a short window
+
+    # 2. scatter microbench (chunk x zipf x dtype sweep) + fused MF step
+    results.append(
+        run_job(
+            "microbench_scatter",
+            [py, os.path.join(REPO, "benchmarks", "microbench.py"), "scatter"],
+            int(900 * scale), OUT_DIR,
+        )
+    )
+    results.append(
+        run_job(
+            "microbench_mf_fused",
+            [py, os.path.join(REPO, "benchmarks", "microbench.py"),
+             "mf_fused"],
+            int(600 * scale), OUT_DIR,
+        )
+    )
+
+    # 3. Criteo-scale stress (>=10M-row bf16 store, pallas scatter)
+    results.append(
+        run_job(
+            "criteo_stress",
+            [py, os.path.join(REPO, "benchmarks", "criteo_stress.py")]
+            + (["--rows", "4194304"] if args.quick else []),
+            int(900 * scale), OUT_DIR,
+        )
+    )
+
+    # 4. all five baseline configs
+    results.append(
+        run_job(
+            "baseline_configs",
+            [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
+             "all"],
+            int(1200 * scale), OUT_DIR,
+        )
+    )
+
+    # 5. profiler trace of the MF step (the fused-kernel decision input).
+    # One untraced call first: same shapes -> the jit cache is warm, so
+    # the trace captures steady-state steps, not compilation
+    # (tracing.profile_trace's own guidance).
+    results.append(
+        run_job(
+            "mf_profile",
+            [py, "-c", (
+                "import sys; sys.path.insert(0, %r)\n"
+                "import os\n"
+                "import jax\n"
+                "from flink_parameter_server_tpu.training import tracing\n"
+                "import bench\n"
+                "os.environ['FPS_BENCH_BATCH'] = '65536'\n"
+                "bench.tpu_updates_per_sec(bench_steps=2)  # compile+warm\n"
+                "with tracing.profile_trace(%r):\n"
+                "    bench.tpu_updates_per_sec(warmup_steps=1, bench_steps=10)\n"
+                "print('trace saved')\n"
+            ) % (REPO, os.path.join(OUT_DIR, "mf_trace"))],
+            int(600 * scale), OUT_DIR,
+        )
+    )
+
+    summary = os.path.join(OUT_DIR, "summary.json")
+    with open(summary, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"summary -> {summary}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

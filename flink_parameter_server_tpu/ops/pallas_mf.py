@@ -1,0 +1,291 @@
+"""Fused MF-SGD Pallas kernel: pull + SGD + push in one pass (item side).
+
+The compiled MF step (core/transform.make_train_step) is three XLA ops on
+the item table: gather ``pulled = table[items]`` (B rows of HBM read),
+SGD math, scatter-add of ``item_deltas`` (B-row read-modify-write) — plus
+the ``pulled``/``deltas`` (B, d) intermediates living in HBM between
+them.  For the gather/scatter-bound MF workload (SURVEY.md §6-§7: the
+headline metric is bandwidth-limited), that is ~4 B-row traversals plus
+2 B-row intermediates per step.
+
+This kernel fuses the item side into ONE sorted pass (the same
+sorted-run structure as ops/pallas_scatter.py): lanes arrive sorted by
+item id; each *unique* item row is DMA'd in once, every lane of its run
+computes ``err = r - p·q`` against that pulled snapshot and accumulates
+the item delta in VMEM, and the updated row is DMA'd out once.  Per-lane
+user rows stay OUTSIDE the kernel as a pre-gathered VMEM-blocked input
+and the per-lane user deltas as a blocked output (XLA's vectorized
+gather/scatter is the right tool for the unsorted user side — fusing it
+would serialize on per-row DMA latency).  Item-side HBM traffic drops
+from O(B) reads + O(B) RMW + 2 intermediates to **O(unique) RMW, no
+intermediates** — under Zipf skew unique << B.
+
+Semantics match the batched step's (same pulled snapshot per microbatch,
+duplicate deltas summed, masked lanes contribute nothing, masked-lane
+predictions computed against the real item row) — verified lane-for-lane
+against make_train_step in tests.  Two documented divergences, both on
+*invalid* lanes only: an out-of-range item id yields a prediction against
+the last table row (the unfused path predicts against a clipped row), and
+its lane updates no user row (the unfused path still applies the user
+delta from the clipped pull).
+
+Status: logic-verified in interpreter mode on CPU; chunk size and the
+on-chip win await a live TPU (benchmarks/microbench.py mf_fused).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
+            out_table_ref, udelta_ref, pred_ref,
+            q_ref, acc_ref, carry_ref, row_ref, sem_in, sem_out,
+            *, chunk: int, lr: float, reg: float):
+    """One grid step = one chunk of lanes sorted by item id.
+
+    ids_ref: (N,) int32 SMEM (scalar-prefetched) — sorted item ids.
+    p_ref: (chunk, d) VMEM — pre-gathered user rows (f32).
+    r_ref / m_ref: (chunk, 1) VMEM — ratings / mask (f32).
+    table_ref/out_table_ref: aliased (capacity, d) HBM item table.
+    udelta_ref: (chunk, d) VMEM out — per-lane user deltas (f32).
+    pred_ref: (chunk, 1) VMEM out — per-lane predictions (f32).
+    q_ref/row_ref: (1, d) VMEM scratch in table dtype (DMA staging).
+    acc_ref: (1, d) f32 VMEM — current run's item-delta accumulator.
+    carry_ref: (1,) int32 SMEM — current run's item id (-1 = none).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    c = pl.program_id(0)
+    num_chunks = pl.num_programs(0)
+    base = c * chunk
+
+    @pl.when(c == 0)
+    def _init():
+        carry_ref[0] = -1
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        q_ref[:] = jnp.zeros_like(q_ref)
+
+    def flush(row_id):
+        """item_table[row_id] = q + acc (one RMW round trip per run)."""
+        row_ref[:] = (
+            q_ref[:].astype(jnp.float32) + acc_ref[:]
+        ).astype(row_ref.dtype)
+        dma = pltpu.make_async_copy(
+            row_ref, out_table_ref.at[pl.ds(row_id, 1)], sem_out
+        )
+        dma.start()
+        dma.wait()
+
+    def load(row_id):
+        dma = pltpu.make_async_copy(
+            table_ref.at[pl.ds(row_id, 1)], q_ref, sem_in
+        )
+        dma.start()
+        dma.wait()
+
+    def lane(i, _):
+        idx = base + i
+        it = ids_ref[idx]
+        cur = carry_ref[0]
+
+        @pl.when(jnp.logical_and(it != cur, cur >= 0))
+        def _boundary():
+            flush(cur)
+
+        @pl.when(it != cur)
+        def _new_run():
+            load(it)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            carry_ref[0] = it
+
+        q = q_ref[0, :].astype(jnp.float32)  # pulled snapshot (run-const)
+        p = p_ref[pl.ds(i, 1), :][0, :]
+        m = m_ref[pl.ds(i, 1), :][0, 0]
+        r = r_ref[pl.ds(i, 1), :][0, 0]
+        pred = jnp.sum(p * q)
+        err = r - pred
+        pred_ref[pl.ds(i, 1), :] = pred[None, None]
+        udelta_ref[pl.ds(i, 1), :] = (
+            (m * lr) * (err * q - reg * p)
+        )[None, :]
+        acc_ref[0, :] = acc_ref[0, :] + (m * lr) * (err * p - reg * q)
+        return 0
+
+    jax.lax.fori_loop(0, chunk, lane, 0)
+
+    @pl.when(c == num_chunks - 1)
+    def _final():
+        @pl.when(carry_ref[0] >= 0)
+        def _():
+            flush(carry_ref[0])
+
+
+def fused_mf_sgd(
+    user_table: Array,
+    item_table: Array,
+    users: Array,
+    items: Array,
+    ratings: Array,
+    mask: Optional[Array] = None,
+    *,
+    learning_rate: float = 0.01,
+    regularization: float = 0.0,
+    chunk: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array, Array]:
+    """One fused MF-SGD microbatch step.
+
+    Returns ``(new_user_table, new_item_table, predictions)`` with
+    predictions in the original lane order — semantically identical to
+    the unfused gather→SGD→scatter step (same snapshot, sum-combined
+    duplicates, masked lanes inert).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    capacity, dim = item_table.shape
+    n = items.shape[0]
+
+    items = items.astype(jnp.int32)
+    users = users.astype(jnp.int32)
+    valid = (items >= 0) & (items < capacity)
+    m = valid if mask is None else (mask & valid)
+    # Only lanes with INVALID ids are routed to the last row (they have no
+    # real row to read); masked-but-valid lanes keep their id so their
+    # returned prediction is computed against the real item row, exactly
+    # like the unfused path.  Deltas are zeroed via ``m`` either way.
+    work_items = jnp.where(valid, items, capacity - 1)
+
+    order = jnp.argsort(work_items)
+    s_items = jnp.take(work_items, order)
+    s_users = jnp.take(users, order)
+    s_r = jnp.take(ratings.astype(jnp.float32), order)
+    s_m = jnp.take(m, order).astype(jnp.float32)
+    # vectorized XLA gather for the unsorted user side (f32 compute)
+    s_p = jnp.take(
+        user_table, jnp.clip(s_users, 0, user_table.shape[0] - 1), axis=0
+    ).astype(jnp.float32)
+
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:
+        pad = n_pad - n
+        s_items = jnp.concatenate(
+            [s_items, jnp.full((pad,), capacity - 1, jnp.int32)]
+        )
+        s_users = jnp.concatenate([s_users, jnp.zeros((pad,), jnp.int32)])
+        s_r = jnp.concatenate([s_r, jnp.zeros((pad,), jnp.float32)])
+        s_m = jnp.concatenate([s_m, jnp.zeros((pad,), jnp.float32)])
+        s_p = jnp.concatenate([s_p, jnp.zeros((pad, dim), jnp.float32)])
+
+    if not isinstance(item_table, jax.core.Tracer):
+        # eager call: aliasing would invalidate the caller's buffer
+        item_table = jnp.copy(item_table)
+
+    grid = (n_pad // chunk,)
+    kernel = functools.partial(
+        _kernel, chunk=chunk,
+        lr=float(learning_rate), reg=float(regularization),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((chunk, dim), lambda c, ids: (c, 0),
+                         memory_space=pltpu.VMEM),  # p
+            pl.BlockSpec((chunk, 1), lambda c, ids: (c, 0),
+                         memory_space=pltpu.VMEM),  # r
+            pl.BlockSpec((chunk, 1), lambda c, ids: (c, 0),
+                         memory_space=pltpu.VMEM),  # m
+            pl.BlockSpec(memory_space=pl.ANY),  # item table (HBM)
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # item table out (aliased)
+            pl.BlockSpec((chunk, dim), lambda c, ids: (c, 0),
+                         memory_space=pltpu.VMEM),  # user deltas
+            pl.BlockSpec((chunk, 1), lambda c, ids: (c, 0),
+                         memory_space=pltpu.VMEM),  # predictions
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, dim), item_table.dtype),  # q (pulled row)
+            pltpu.VMEM((1, dim), jnp.float32),  # acc
+            pltpu.SMEM((1,), jnp.int32),  # carry id
+            pltpu.VMEM((1, dim), item_table.dtype),  # RMW staging
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    s_r2 = s_r.reshape(-1, 1)
+    s_m2 = s_m.reshape(-1, 1)
+    new_item_table, udeltas, preds = pl.pallas_call(
+        kernel,
+        out_shape=[
+            jax.ShapeDtypeStruct(item_table.shape, item_table.dtype),
+            jax.ShapeDtypeStruct((n_pad, dim), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.float32),
+        ],
+        grid_spec=grid_spec,
+        input_output_aliases={4: 0},  # (ids, p, r, m, table) -> table
+        interpret=interpret,
+    )(s_items, s_p, s_r2, s_m2, item_table)
+
+    # user side: vectorized XLA scatter-add of the per-lane deltas
+    # (padding lanes carry zero deltas onto user row 0 — inert)
+    new_user_table = user_table.at[s_users].add(
+        udeltas.astype(user_table.dtype), mode="drop"
+    )
+    # un-permute predictions to the original lane order (scatter-based
+    # inverse permutation — no second argsort)
+    pred = jnp.zeros((n,), jnp.float32).at[order[:n]].set(preds[:n, 0])
+    return new_user_table, new_item_table, pred
+
+
+def make_fused_mf_train_step(
+    *,
+    learning_rate: float = 0.01,
+    regularization: float = 0.0,
+    chunk: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """A drop-in alternative to ``make_train_step(OnlineMatrixFactorization,
+    spec)`` for the MF flagship: same ``(table, state, batch) -> (table,
+    state, out)`` signature (state = user factor table), fused item side."""
+
+    def step(item_table, user_table, batch):
+        mask = batch.get("mask")
+        new_users, new_items, pred = fused_mf_sgd(
+            user_table,
+            item_table,
+            batch["user"],
+            batch["item"],
+            batch["rating"],
+            mask,
+            learning_rate=learning_rate,
+            regularization=regularization,
+            chunk=chunk,
+            interpret=interpret,
+        )
+        m = (
+            jnp.ones_like(pred)
+            if mask is None
+            else mask.astype(jnp.float32)
+        )
+        out = {
+            "prediction": pred,
+            "error": (batch["rating"].astype(jnp.float32) - pred) * m,
+        }
+        return new_items, new_users, out
+
+    return step
+
+
+__all__ = ["fused_mf_sgd", "make_fused_mf_train_step"]
