@@ -87,13 +87,21 @@ def tpu_updates_per_sec(
                 f"{sorted(valid)}"
             )
         dtype = valid[name]
+    # FPS_BENCH_FUSED=1: run the fused pull+SGD+push Pallas step
+    # (ops/pallas_mf.py) instead of the unfused gather->SGD->scatter.
+    # Single-shard TPU only — on a multi-chip slice the fused run stays
+    # single-chip (no mesh) so the flag never silently benchmarks the
+    # unfused path under a "fused" label.
+    fused_requested = os.environ.get("FPS_BENCH_FUSED") == "1"
+
     # Multi-chip TPU: shard over a dp × ps mesh and report PER-CHIP rate.
     # (Only on real TPUs — virtual CPU meshes on this 1-core host trip
     # XLA's collective-rendezvous watchdog at bench-scale steps.)
     mesh = None
     n_chips = 1
     if (
-        jax.default_backend() == "tpu"
+        not fused_requested
+        and jax.default_backend() == "tpu"
         and len(jax.devices()) > 1
         and jax.process_count() == 1  # single-process only: device_put to
         # non-addressable devices would crash on multi-host slices
@@ -104,6 +112,9 @@ def tpu_updates_per_sec(
         ps = next((c for c in (4, 2) if n_chips % c == 0), 1)
         mesh = make_mesh(ps_parallelism=ps)  # dp absorbs the rest
         batch = batch * mesh.shape["dp"]  # scale work with dp
+
+    # (interpret mode on CPU is not a perf number — flag ignored there)
+    fused = fused_requested and jax.default_backend() == "tpu"
 
     # lr matches cpu_per_record_baseline (both sides numerically stable).
     logic = OnlineMatrixFactorization(
@@ -117,6 +128,7 @@ def tpu_updates_per_sec(
 
     rng = np.random.default_rng(0)
     items = ((rng.zipf(1.2, batch) - 1) % num_items).astype(np.int32)
+    unique_items = len(np.unique(items))
     data = {
         "user": jnp.asarray(rng.integers(0, num_users, batch).astype(np.int32)),
         "item": jnp.asarray(items),
@@ -130,7 +142,31 @@ def tpu_updates_per_sec(
         sh = NamedSharding(mesh, PartitionSpec("dp"))
         data = {k: jax.device_put(v, sh) for k, v in data.items()}
 
-    step = jax.jit(make_train_step(logic, store.spec), donate_argnums=(0, 1))
+    if fused:
+        from flink_parameter_server_tpu.ops.pallas_mf import (
+            make_fused_mf_train_step,
+        )
+
+        raw_chunk = os.environ.get("FPS_BENCH_FUSED_CHUNK", "1024")
+        try:
+            chunk = int(raw_chunk)
+        except ValueError:
+            raise SystemExit(
+                f"FPS_BENCH_FUSED_CHUNK={raw_chunk!r}: expected a positive "
+                f"integer"
+            ) from None
+        if chunk <= 0:
+            raise SystemExit(
+                f"FPS_BENCH_FUSED_CHUNK={chunk}: must be positive"
+            )
+        step = jax.jit(
+            make_fused_mf_train_step(learning_rate=0.01, chunk=chunk),
+            donate_argnums=(0, 1),
+        )
+    else:
+        step = jax.jit(
+            make_train_step(logic, store.spec), donate_argnums=(0, 1)
+        )
     table = store.table
     for _ in range(warmup_steps):
         table, state, out = step(table, state, data)
@@ -154,11 +190,20 @@ def tpu_updates_per_sec(
     p50_ms = float(np.percentile(np.array(lats), 50) * 1e3)
 
     # HBM traffic model for the gather/scatter-bound MF step (the honest
-    # perf yardstick for a bandwidth-bound workload): per step each side
+    # perf yardstick for a bandwidth-bound workload).  Unfused: each side
     # (user state table, item store) does a batch-row gather (1 read) and
     # a batch-row scatter RMW (1 read + 1 write) → 6 row-traversals.
+    # Fused (ops/pallas_mf.py): the item side touches each UNIQUE row
+    # once (1 read + 1 write) and the sort adds ~2 permute passes over
+    # the id/lane arrays; the user side is unchanged.
     el = jnp.dtype(dtype).itemsize
-    hbm_bytes_per_step = 6 * batch * dim * el
+    if fused:
+        hbm_bytes_per_step = (
+            (3 * batch + 2 * unique_items) * dim * el  # rows
+            + 8 * batch * 4  # id sort/permute passes (int32)
+        )
+    else:
+        hbm_bytes_per_step = 6 * batch * dim * el
     step_time = dt / bench_steps
     peak = _hbm_peak_bytes_per_sec()
     bandwidth_util = (
@@ -171,6 +216,7 @@ def tpu_updates_per_sec(
         "batch": batch,
         "hbm_bytes_per_step": hbm_bytes_per_step,
         "bandwidth_util": bandwidth_util,
+        "fused_step": fused,
     }
 
 
@@ -263,6 +309,7 @@ def main():
                     "table_dtype": r["table_dtype"],
                     "hbm_bytes_per_step": r["hbm_bytes_per_step"],
                     "bandwidth_util": round(util, 4) if util else None,
+                    "fused_step": r["fused_step"],
                 },
             }
         )

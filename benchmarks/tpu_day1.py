@@ -71,17 +71,21 @@ def main():
     py = sys.executable
     results = []
 
-    # 1. headline bench, bf16, batch sweep
+    # 1. headline bench, bf16, batch sweep — unfused AND fused step
     for batch in (16_384, 65_536, 262_144):
-        env = dict(os.environ)
-        env["FPS_BENCH_BATCH"] = str(batch)
-        env["FPS_BENCH_DTYPE"] = "bfloat16"
-        results.append(
-            run_job(
-                f"bench_b{batch}", [py, os.path.join(REPO, "bench.py")],
-                int(600 * scale), OUT_DIR, env=env,
+        for fused in ("0", "1"):
+            env = dict(os.environ)
+            env["FPS_BENCH_BATCH"] = str(batch)
+            env["FPS_BENCH_DTYPE"] = "bfloat16"
+            env["FPS_BENCH_FUSED"] = fused
+            tag = "fused" if fused == "1" else "unfused"
+            results.append(
+                run_job(
+                    f"bench_b{batch}_{tag}",
+                    [py, os.path.join(REPO, "bench.py")],
+                    int(600 * scale), OUT_DIR, env=env,
+                )
             )
-        )
         if args.quick:
             break  # one batch size is enough for a short window
 

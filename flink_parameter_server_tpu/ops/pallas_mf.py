@@ -128,64 +128,28 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             flush(carry_ref[0])
 
 
-def fused_mf_sgd(
-    user_table: Array,
+def _sorted_fused_call(
     item_table: Array,
-    users: Array,
-    items: Array,
-    ratings: Array,
-    mask: Optional[Array] = None,
+    s_items: Array,
+    s_p: Array,
+    s_r: Array,
+    s_m: Array,
     *,
-    learning_rate: float = 0.01,
-    regularization: float = 0.0,
-    chunk: int = 1024,
-    interpret: Optional[bool] = None,
+    learning_rate: float,
+    regularization: float,
+    chunk: int,
+    interpret: bool,
 ) -> Tuple[Array, Array, Array]:
-    """One fused MF-SGD microbatch step.
+    """Kernel invocation on pre-sorted, chunk-padded lanes.
 
-    Returns ``(new_user_table, new_item_table, predictions)`` with
-    predictions in the original lane order — semantically identical to
-    the unfused gather→SGD→scatter step (same snapshot, sum-combined
-    duplicates, masked lanes inert).
-    """
+    Returns ``(new_item_table, udeltas, preds)`` in sorted lane order —
+    the composable core shared by the single-shard wrapper and the
+    ps-sharded shard_map wrapper."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     capacity, dim = item_table.shape
-    n = items.shape[0]
-
-    items = items.astype(jnp.int32)
-    users = users.astype(jnp.int32)
-    valid = (items >= 0) & (items < capacity)
-    m = valid if mask is None else (mask & valid)
-    # Only lanes with INVALID ids are routed to the last row (they have no
-    # real row to read); masked-but-valid lanes keep their id so their
-    # returned prediction is computed against the real item row, exactly
-    # like the unfused path.  Deltas are zeroed via ``m`` either way.
-    work_items = jnp.where(valid, items, capacity - 1)
-
-    order = jnp.argsort(work_items)
-    s_items = jnp.take(work_items, order)
-    s_users = jnp.take(users, order)
-    s_r = jnp.take(ratings.astype(jnp.float32), order)
-    s_m = jnp.take(m, order).astype(jnp.float32)
-    # vectorized XLA gather for the unsorted user side (f32 compute)
-    s_p = jnp.take(
-        user_table, jnp.clip(s_users, 0, user_table.shape[0] - 1), axis=0
-    ).astype(jnp.float32)
-
-    n_pad = ((n + chunk - 1) // chunk) * chunk
-    if n_pad != n:
-        pad = n_pad - n
-        s_items = jnp.concatenate(
-            [s_items, jnp.full((pad,), capacity - 1, jnp.int32)]
-        )
-        s_users = jnp.concatenate([s_users, jnp.zeros((pad,), jnp.int32)])
-        s_r = jnp.concatenate([s_r, jnp.zeros((pad,), jnp.float32)])
-        s_m = jnp.concatenate([s_m, jnp.zeros((pad,), jnp.float32)])
-        s_p = jnp.concatenate([s_p, jnp.zeros((pad, dim), jnp.float32)])
+    n_pad = s_items.shape[0]
 
     if not isinstance(item_table, jax.core.Tracer):
         # eager call: aliasing would invalidate the caller's buffer
@@ -226,7 +190,7 @@ def fused_mf_sgd(
     )
     s_r2 = s_r.reshape(-1, 1)
     s_m2 = s_m.reshape(-1, 1)
-    new_item_table, udeltas, preds = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         out_shape=[
             jax.ShapeDtypeStruct(item_table.shape, item_table.dtype),
@@ -238,6 +202,86 @@ def fused_mf_sgd(
         interpret=interpret,
     )(s_items, s_p, s_r2, s_m2, item_table)
 
+
+def _sort_pad_lanes(
+    capacity: int,
+    user_table: Array,
+    users: Array,
+    items: Array,
+    ratings: Array,
+    mask: Optional[Array],
+    chunk: int,
+):
+    """Sort lanes by item id and pad to a chunk multiple.
+
+    Only lanes with INVALID ids are routed to the last row (they have no
+    real row to read); masked-but-valid lanes keep their id so their
+    returned prediction is computed against the real item row, exactly
+    like the unfused path.  Deltas are zeroed via the mask either way."""
+    n = items.shape[0]
+    dim = user_table.shape[1]
+    items = items.astype(jnp.int32)
+    users = users.astype(jnp.int32)
+    valid = (items >= 0) & (items < capacity)
+    m = valid if mask is None else (mask & valid)
+    work_items = jnp.where(valid, items, capacity - 1)
+
+    order = jnp.argsort(work_items)
+    s_items = jnp.take(work_items, order)
+    s_users = jnp.take(users, order)
+    s_r = jnp.take(ratings.astype(jnp.float32), order)
+    s_m = jnp.take(m, order).astype(jnp.float32)
+    # vectorized XLA gather for the unsorted user side (f32 compute)
+    s_p = jnp.take(
+        user_table, jnp.clip(s_users, 0, user_table.shape[0] - 1), axis=0
+    ).astype(jnp.float32)
+
+    n_pad = ((n + chunk - 1) // chunk) * chunk
+    if n_pad != n:
+        pad = n_pad - n
+        s_items = jnp.concatenate(
+            [s_items, jnp.full((pad,), capacity - 1, jnp.int32)]
+        )
+        s_users = jnp.concatenate([s_users, jnp.zeros((pad,), jnp.int32)])
+        s_r = jnp.concatenate([s_r, jnp.zeros((pad,), jnp.float32)])
+        s_m = jnp.concatenate([s_m, jnp.zeros((pad,), jnp.float32)])
+        s_p = jnp.concatenate([s_p, jnp.zeros((pad, dim), jnp.float32)])
+    return order, s_items, s_users, s_r, s_m, s_p
+
+
+def fused_mf_sgd(
+    user_table: Array,
+    item_table: Array,
+    users: Array,
+    items: Array,
+    ratings: Array,
+    mask: Optional[Array] = None,
+    *,
+    learning_rate: float = 0.01,
+    regularization: float = 0.0,
+    chunk: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array, Array]:
+    """One fused MF-SGD microbatch step (single shard).
+
+    Returns ``(new_user_table, new_item_table, predictions)`` with
+    predictions in the original lane order — semantically identical to
+    the unfused gather→SGD→scatter step (same snapshot, sum-combined
+    duplicates, masked lanes inert; see module docstring for the two
+    invalid-lane divergences).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = items.shape[0]
+    capacity = item_table.shape[0]
+    order, s_items, s_users, s_r, s_m, s_p = _sort_pad_lanes(
+        capacity, user_table, users, items, ratings, mask, chunk
+    )
+    new_item_table, udeltas, preds = _sorted_fused_call(
+        item_table, s_items, s_p, s_r, s_m,
+        learning_rate=learning_rate, regularization=regularization,
+        chunk=chunk, interpret=interpret,
+    )
     # user side: vectorized XLA scatter-add of the per-lane deltas
     # (padding lanes carry zero deltas onto user row 0 — inert)
     new_user_table = user_table.at[s_users].add(
@@ -246,6 +290,121 @@ def fused_mf_sgd(
     # un-permute predictions to the original lane order (scatter-based
     # inverse permutation — no second argsort)
     pred = jnp.zeros((n,), jnp.float32).at[order[:n]].set(preds[:n, 0])
+    return new_user_table, new_item_table, pred
+
+
+def fused_mf_sgd_sharded(
+    user_table: Array,
+    item_table: Array,
+    users: Array,
+    items: Array,
+    ratings: Array,
+    mask: Optional[Array] = None,
+    *,
+    mesh,
+    ps_axis: str = "ps",
+    learning_rate: float = 0.01,
+    regularization: float = 0.0,
+    chunk: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array, Array]:
+    """The fused step over a ps-sharded item table (the giant-table
+    layout: table row-blocked over ``ps``, batch + user table replicated).
+
+    Each ps shard runs the fused kernel on its local block with lanes
+    outside its row range masked off; since a lane's item row lives on
+    exactly one shard, per-lane user deltas and predictions are disjoint
+    across shards and ONE ``psum`` over ``ps`` assembles them — there is
+    no separate pull round-trip at all.  The reference's whole
+    pull/push message plane for this step becomes that single collective
+    (SURVEY.md §2 "TPU-native equivalent").
+
+    dp-sharding the batch is NOT supported here: item blocks would be
+    replicated over dp and the in-kernel writes would diverge across dp
+    rows (the unfused/locality paths handle that case).
+
+    Divergence from the single-shard fused step, on *invalid* lanes
+    only: a globally out-of-range item id yields prediction 0.0 (no
+    shard owns it), where the single-shard step predicts against the
+    routed last row.  Valid lanes — masked included — are identical.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    ps = mesh.shape[ps_axis]
+    for ax, sz in mesh.shape.items():
+        if ax != ps_axis and sz != 1:
+            raise ValueError(
+                f"fused sharded step supports ps-only meshes (item blocks "
+                f"would be replicated over axis {ax!r} (size {sz}) and the "
+                f"in-kernel writes would diverge)"
+            )
+    capacity, dim = item_table.shape
+    if capacity % ps != 0:
+        raise ValueError(
+            f"item table capacity {capacity} must divide evenly over "
+            f"ps={ps} shards (pad the table — ShardedParamStore does "
+            f"this automatically)"
+        )
+    rows = capacity // ps
+    n = items.shape[0]
+    lr, reg = learning_rate, regularization
+
+    def body(local_table, u_table, b_users, b_items, b_ratings, b_mask):
+        ps_idx = jax.lax.axis_index(ps_axis)
+        lo = ps_idx * rows
+        rel = b_items.astype(jnp.int32) - lo
+        hit = (rel >= 0) & (rel < rows)
+        m = hit if b_mask is None else (hit & b_mask)
+        order, s_items, s_users, s_r, s_m, s_p = _sort_pad_lanes(
+            rows, u_table, b_users, jnp.where(hit, rel, -1), b_ratings,
+            m, chunk,
+        )
+        new_block, udeltas, preds = _sorted_fused_call(
+            local_table, s_items, s_p, s_r, s_m,
+            learning_rate=lr, regularization=reg,
+            chunk=chunk, interpret=interpret,
+        )
+        # un-permute to lane order, then assemble across shards: each
+        # lane was computed on exactly its item's owning shard (zero
+        # elsewhere), so one psum yields the full per-lane values
+        lane_udelta = (
+            jnp.zeros((n, udeltas.shape[1]), jnp.float32)
+            .at[order[:n]]
+            .set(udeltas[:n])
+        )
+        lane_pred = (
+            jnp.zeros((n,), jnp.float32).at[order[:n]].set(preds[:n, 0])
+        )
+        # a non-owning shard computed its (routed-row) pred for foreign
+        # lanes — only the owner contributes (udeltas are already zeroed
+        # by the kernel mask, which includes ``hit``)
+        lane_pred = jnp.where(hit, lane_pred, 0.0)
+        lane_udelta = jax.lax.psum(lane_udelta, ps_axis)
+        lane_pred = jax.lax.psum(lane_pred, ps_axis)
+        # user table is replicated over ps; every shard applies the same
+        # psum'd deltas, so it stays replicated
+        new_users = u_table.at[b_users.astype(jnp.int32)].add(
+            lane_udelta.astype(u_table.dtype), mode="drop"
+        )
+        return new_block, new_users, lane_pred
+
+    rep = P()
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ps_axis, None), rep, rep, rep, rep, rep),
+        out_specs=(P(ps_axis, None), rep, rep),
+        check_vma=False,
+    )
+    mask_in = (
+        jnp.ones(n, bool) if mask is None else mask
+    )
+    new_item_table, new_user_table, pred = fn(
+        item_table, user_table, users, items, ratings, mask_in
+    )
     return new_user_table, new_item_table, pred
 
 
@@ -288,4 +447,8 @@ def make_fused_mf_train_step(
     return step
 
 
-__all__ = ["fused_mf_sgd", "make_fused_mf_train_step"]
+__all__ = [
+    "fused_mf_sgd",
+    "fused_mf_sgd_sharded",
+    "make_fused_mf_train_step",
+]

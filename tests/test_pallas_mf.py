@@ -148,6 +148,54 @@ def test_fused_oob_items_dropped():
     )
 
 
+def test_fused_sharded_matches_single_shard():
+    """ps-only sharded fused step == single-shard fused step == unfused
+    reference, with the one-psum assembly."""
+    from jax.sharding import Mesh
+
+    from flink_parameter_server_tpu.ops.pallas_mf import fused_mf_sgd_sharded
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ps",))
+    rng = np.random.default_rng(23)
+    B, num_users, num_items, dim = 48, 10, 16, 4  # 16 rows / 4 shards
+    batch = _batch(rng, B, num_users, num_items)
+    # mask some lanes to exercise the masked-but-valid pred path
+    m = rng.random(B) < 0.8
+    batch["mask"] = jnp.asarray(m)
+
+    u_ref, i_ref, p_ref, _, _ = _reference_step(num_users, num_items, dim,
+                                                batch)
+    store = ShardedParamStore.create(
+        num_items, (dim,), init_fn=ranged_random_factor(5, (dim,))
+    )
+    logic = OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(LR, REG), seed=3
+    )
+    users0 = logic.init_state(jax.random.PRNGKey(0))
+    u_s, i_s, p_s = fused_mf_sgd_sharded(
+        users0, store.table, batch["user"], batch["item"], batch["rating"],
+        batch["mask"], mesh=mesh, learning_rate=LR, regularization=REG,
+        chunk=8, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(p_s), p_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(i_s[:num_items]), i_ref, rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(u_s), u_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_sharded_rejects_dp_mesh(mesh):
+    from flink_parameter_server_tpu.ops.pallas_mf import fused_mf_sgd_sharded
+
+    rng = np.random.default_rng(3)
+    batch = _batch(rng, 8, 4, 8)
+    with pytest.raises(ValueError, match="ps-only meshes"):
+        fused_mf_sgd_sharded(
+            jnp.zeros((4, 2)), jnp.zeros((8, 2)), batch["user"],
+            batch["item"], batch["rating"], mesh=mesh, interpret=True,
+        )
+
+
 def test_fused_train_step_wrapper():
     """make_fused_mf_train_step slots into the (table, state, batch)
     contract and can be jitted."""
