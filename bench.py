@@ -43,7 +43,7 @@ def _ensure_backend_alive() -> str:
 def tpu_updates_per_sec(
     num_users=100_000,
     num_items=131_072,
-    dim=64,
+    dim=None,
     batch=None,
     warmup_steps=3,
     bench_steps=30,
@@ -93,6 +93,25 @@ def tpu_updates_per_sec(
     # single-chip (no mesh) so the flag never silently benchmarks the
     # unfused path under a "fused" label.
     fused_requested = os.environ.get("FPS_BENCH_FUSED") == "1"
+    if dim is None:
+        # The fused/pallas kernels need dim % 128 == 0 on real Mosaic
+        # (measured — benchmarks/mosaic_probe.py); the unfused default
+        # stays at the reference-shaped 64.
+        raw = os.environ.get("FPS_BENCH_DIM", "128" if fused_requested
+                             else "64")
+        try:
+            dim = int(raw)
+        except ValueError:
+            raise SystemExit(
+                f"FPS_BENCH_DIM={raw!r}: expected a positive integer"
+            ) from None
+        if dim <= 0:
+            raise SystemExit(f"FPS_BENCH_DIM={dim}: must be positive")
+    if fused_requested and jax.default_backend() == "tpu" and dim % 128:
+        raise SystemExit(
+            f"FPS_BENCH_FUSED=1 needs dim % 128 == 0 on TPU (Mosaic lane "
+            f"alignment); got dim={dim}. Set FPS_BENCH_DIM=128."
+        )
 
     # Multi-chip TPU: shard over a dp × ps mesh and report PER-CHIP rate.
     # (Only on real TPUs — virtual CPU meshes on this 1-core host trip

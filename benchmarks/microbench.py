@@ -34,7 +34,7 @@ def _timeit(fn, *args, warmup=2, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_scatter(capacity=131_072, dim=64, batch=16_384):
+def bench_scatter(capacity=131_072, dim=128, batch=16_384):
     """XLA scatter-add vs the Pallas sorted-run kernel under skew.
 
     On TPU this is the `chunk`-tuning run the scatter_impl default hangs
@@ -133,7 +133,7 @@ def bench_mf(batch=16_384, dim=64):
     )
 
 
-def bench_mf_fused(capacity=131_072, num_users=100_000, dim=64,
+def bench_mf_fused(capacity=131_072, num_users=100_000, dim=128,
                    batch=16_384, zipf=1.2):
     """Fused pull+SGD+push kernel vs the unfused XLA step (TPU only —
     interpret mode is not a perf number)."""

@@ -1,0 +1,240 @@
+"""Empirical probe of real-Mosaic alignment rules (run on a live TPU).
+
+Round-2 finding: both Pallas kernels compile in interpreter mode but are
+rejected by the real Mosaic compiler on slice-alignment grounds.  This
+script compiles a battery of minimal kernels exercising each access
+pattern the redesign wants to use, and prints PASS/FAIL per pattern, so
+the rework targets measured constraints instead of guesses.
+
+    python benchmarks/mosaic_probe.py
+"""
+from __future__ import annotations
+
+import functools
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import jax.experimental.pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def check(name, fn):
+    try:
+        out = fn()
+        jax.block_until_ready(out)
+        print(f"PASS {name}")
+        return True
+    except Exception as e:  # noqa: BLE001
+        msg = str(e).split("\n")
+        key = next((l for l in msg if "Mosaic" in l or "must be aligned" in l
+                    or "statically prove" in l), msg[0] if msg else "?")
+        print(f"FAIL {name}: {key[:200]}")
+        return False
+
+
+def hbm_dma_row(dtype, rows_per_window, dim, dyn_mult):
+    """DMA a window of the HBM table at a dynamic row offset to VMEM."""
+    def kernel(ids_ref, table_ref, out_ref, win_ref, sem):
+        r = ids_ref[0]
+        off = r * dyn_mult
+        dma = pltpu.make_async_copy(
+            table_ref.at[pl.ds(off, rows_per_window)], win_ref, sem)
+        dma.start()
+        dma.wait()
+        out_ref[:] = win_ref[:]
+
+    table = jnp.arange(256 * dim, dtype=jnp.float32).reshape(256, dim)
+    table = table.astype(dtype)
+    ids = jnp.array([3], jnp.int32)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((rows_per_window, dim), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((rows_per_window, dim), dtype),
+                        pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_per_window, dim), dtype),
+        grid_spec=spec)(ids, table)
+
+
+def hbm_dma_write(dtype, rows_per_window, dim, dyn_mult):
+    """DMA VMEM window -> HBM table at a dynamic row offset (aliased)."""
+    def kernel(ids_ref, table_ref, out_ref, win_ref, sem):
+        r = ids_ref[0]
+        win_ref[:] = jnp.full_like(win_ref, 7)
+        dma = pltpu.make_async_copy(
+            win_ref, out_ref.at[pl.ds(r * dyn_mult, rows_per_window)], sem)
+        dma.start()
+        dma.wait()
+
+    table = jnp.zeros((256, dim), dtype)
+    ids = jnp.array([3], jnp.int32)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((rows_per_window, dim), dtype),
+                        pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((256, dim), dtype),
+        grid_spec=spec, input_output_aliases={1: 0})(ids, table)
+
+
+def vmem_slice(dtype, dim, group, mult):
+    """Read an (group, dim) slice of a VMEM block at offset g*mult in a loop."""
+    def kernel(x_ref, o_ref, acc_ref):
+        def body(g, _):
+            acc_ref[:] = acc_ref[:] + x_ref[pl.ds(g * mult, group), :]
+            return 0
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        jax.lax.fori_loop(0, x_ref.shape[0] // mult, body, 0)
+        o_ref[:] = acc_ref[:]
+
+    x = jnp.ones((64, dim), dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((group, dim), dtype),
+        in_specs=[pl.BlockSpec((64, dim), lambda: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((group, dim), lambda: (0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((group, dim), dtype)],
+    )(x)
+
+
+def vmem_store_slice(dtype, dim, group, mult):
+    """Write an (group, dim) slice of a VMEM out block at offset g*mult."""
+    def kernel(x_ref, o_ref):
+        def body(g, _):
+            o_ref[pl.ds(g * mult, group), :] = (
+                x_ref[pl.ds(g * mult, group), :] * 2)
+            return 0
+        jax.lax.fori_loop(0, x_ref.shape[0] // mult, body, 0)
+
+    x = jnp.ones((64, dim), dtype)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((64, dim), dtype),
+        in_specs=[pl.BlockSpec((64, dim), lambda: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((64, dim), lambda: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )(x)
+
+
+def masked_extract(dtype, dim):
+    """Extract row s of an (8, dim) tile via iota mask (no slicing)."""
+    def kernel(ids_ref, x_ref, o_ref):
+        s = ids_ref[0]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (8, dim), 0)
+        sel = jnp.where(rows == s, x_ref[:].astype(jnp.float32), 0.0)
+        o_ref[:] = jnp.sum(sel, axis=0, keepdims=True)
+
+    x = jnp.arange(8 * dim, dtype=jnp.float32).reshape(8, dim).astype(dtype)
+    ids = jnp.array([5], jnp.int32)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((8, dim), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, dim), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((1, dim), jnp.float32),
+        grid_spec=spec)(ids, x)
+
+
+def select_matmul(dtype, dim):
+    """acc += S @ G with S an (8,8) one-hot built from scalar compares."""
+    def kernel(ids_ref, x_ref, o_ref):
+        s = ids_ref[0]
+        j = ids_ref[1]
+        r8 = jax.lax.broadcasted_iota(jnp.int32, (8, 8), 0)
+        c8 = jax.lax.broadcasted_iota(jnp.int32, (8, 8), 1)
+        S = ((r8 == s) & (c8 == j)).astype(jnp.float32)
+        G = x_ref[:].astype(jnp.float32)
+        o_ref[:] = jax.lax.dot_general(
+            S, G, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    x = jnp.arange(8 * dim, dtype=jnp.float32).reshape(8, dim).astype(dtype)
+    ids = jnp.array([5, 2], jnp.int32)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((8, dim), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, dim), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, dim), jnp.float32),
+        grid_spec=spec)(ids, x)
+
+
+def ids_col_slice(group, mult):
+    """Slice an (N,1) int32 VMEM column at dynamic aligned offsets."""
+    def kernel(x_ref, o_ref):
+        def body(g, _):
+            o_ref[:] = x_ref[pl.ds(g * mult, group), :]
+            return 0
+        jax.lax.fori_loop(0, x_ref.shape[0] // mult, body, 0)
+
+    x = jnp.arange(64, dtype=jnp.int32).reshape(64, 1)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((group, 1), jnp.int32),
+        in_specs=[pl.BlockSpec((64, 1), lambda: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((group, 1), lambda: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )(x)
+
+
+def main():
+    assert jax.default_backend() == "tpu", "probe needs a live TPU"
+    results = {}
+    for dt, dname in [(jnp.float32, "f32"), (jnp.bfloat16, "bf16")]:
+        for dim in (64, 128):
+            results[f"hbm_dma_read_1row_{dname}_d{dim}"] = check(
+                f"hbm dma read 1 row dyn offset {dname} d={dim}",
+                functools.partial(hbm_dma_row, dt, 1, dim, 1))
+            results[f"hbm_dma_read_8row_{dname}_d{dim}"] = check(
+                f"hbm dma read 8-row window at 8*w {dname} d={dim}",
+                functools.partial(hbm_dma_row, dt, 8, dim, 8))
+            results[f"hbm_dma_write_8row_{dname}_d{dim}"] = check(
+                f"hbm dma write 8-row window at 8*w {dname} d={dim}",
+                functools.partial(hbm_dma_write, dt, 8, dim, 8))
+            results[f"vmem_slice8_{dname}_d{dim}"] = check(
+                f"vmem read (8,d) slice at 8*g {dname} d={dim}",
+                functools.partial(vmem_slice, dt, dim, 8, 8))
+            results[f"vmem_slice1_{dname}_d{dim}"] = check(
+                f"vmem read (1,d) slice at dyn g {dname} d={dim}",
+                functools.partial(vmem_slice, dt, dim, 1, 1))
+            results[f"vmem_store8_{dname}_d{dim}"] = check(
+                f"vmem write (8,d) slice at 8*g {dname} d={dim}",
+                functools.partial(vmem_store_slice, dt, dim, 8, 8))
+            results[f"masked_extract_{dname}_d{dim}"] = check(
+                f"masked row extract {dname} d={dim}",
+                functools.partial(masked_extract, dt, dim))
+            results[f"select_matmul_{dname}_d{dim}"] = check(
+                f"one-hot select matmul {dname} d={dim}",
+                functools.partial(select_matmul, dt, dim))
+    results["ids_col_slice8"] = check(
+        "int32 (8,1) column slice at 8*g",
+        functools.partial(ids_col_slice, 8, 8))
+    results["ids_col_slice16"] = check(
+        "int32 (16,1) column slice at 16*g",
+        functools.partial(ids_col_slice, 16, 16))
+    n_pass = sum(results.values())
+    print(f"\n{n_pass}/{len(results)} patterns pass")
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Array = jax.Array
@@ -89,9 +90,19 @@ class StoreSpec:
         return self.mesh.shape[self.ps_axis]
 
     @property
-    def padded_capacity(self) -> int:
+    def rows_per_shard(self) -> int:
+        """Per-shard row count, window-aligned for the pallas kernel.
+
+        Real Mosaic reads/writes the table in aligned 8-row windows
+        (ops/pallas_scatter.WINDOW); aligning every shard's block here
+        means the kernel path never needs a pad-copy of the table."""
         n = self.num_shards
-        return ((self.capacity + n - 1) // n) * n
+        per = (self.capacity + n - 1) // n
+        return ((per + 7) // 8) * 8
+
+    @property
+    def padded_capacity(self) -> int:
+        return self.rows_per_shard * self.num_shards
 
     def sharding(self) -> Optional[NamedSharding]:
         if self.mesh is None:
@@ -186,45 +197,58 @@ def push(
 
     if spec.update == "add":
         if spec.scatter_impl == "pallas":
-            if spec.num_shards == 1:
-                from ..ops.pallas_scatter import (
-                    scatter_add as pallas_scatter_add,
-                )
+            from ..ops import pallas_scatter as _pallas
 
-                return pallas_scatter_add(
+            # Real Mosaic constrains the compiled kernel's shapes
+            # (dim % 128, capacity % 8 — measured, see
+            # benchmarks/mosaic_probe.py).  Interpreter mode (non-TPU)
+            # has no dim constraint; capacity is window-aligned by
+            # rows_per_shard either way.
+            row_width = int(np.prod(spec.value_shape)) if spec.value_shape else 1
+            shapes_ok = jax.default_backend() != "tpu" or _pallas.supports_shape(
+                spec.rows_per_shard, row_width
+            )
+            if not shapes_ok:
+                _note_pallas_fallback(
+                    f"table row width {row_width} not a multiple of 128 "
+                    f"(Mosaic lane alignment)"
+                )
+            elif spec.num_shards == 1:
+                return _pallas.scatter_add(
                     table, flat_ids, flat_deltas,
                     None if mask is None else flat_mask,
                 )
-            # Sharded: run the kernel per ps shard under shard_map (the
-            # explicit collective plane).  Requires the flat batch length
-            # to divide the dp size for the all_gather specs; otherwise
-            # fall back to XLA scatter.
-            from ..parallel.collectives import shard_push_add
-            from ..parallel.mesh import DP_AXIS
+            else:
+                # Sharded: run the kernel per ps shard under shard_map
+                # (the explicit collective plane).  Requires the flat
+                # batch length to divide the dp size for the all_gather
+                # specs; otherwise fall back to XLA scatter.
+                from ..parallel.collectives import shard_push_add
+                from ..parallel.mesh import DP_AXIS
 
-            mesh = spec.mesh
-            dp_axis = (
-                DP_AXIS
-                if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
-                else None
-            )
-            n = flat_ids.shape[0]
-            if dp_axis is None or n % mesh.shape[dp_axis] == 0:
-                # mask=None: masked lanes' deltas were zeroed above, so a
-                # no-op under add — skip the extra mask all_gather
-                return shard_push_add(
-                    table,
-                    flat_ids,
-                    flat_deltas,
-                    None,
-                    mesh=mesh,
-                    ps_axis=spec.ps_axis,
-                    dp_axis=dp_axis,
-                    impl="pallas",
+                mesh = spec.mesh
+                dp_axis = (
+                    DP_AXIS
+                    if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
+                    else None
                 )
-            _note_pallas_fallback(
-                f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
-            )
+                n = flat_ids.shape[0]
+                if dp_axis is None or n % mesh.shape[dp_axis] == 0:
+                    # mask=None: masked lanes' deltas were zeroed above,
+                    # so a no-op under add — skip the extra mask all_gather
+                    return shard_push_add(
+                        table,
+                        flat_ids,
+                        flat_deltas,
+                        None,
+                        mesh=mesh,
+                        ps_axis=spec.ps_axis,
+                        dp_axis=dp_axis,
+                        impl="pallas",
+                    )
+                _note_pallas_fallback(
+                    f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
+                )
         return table.at[flat_ids].add(
             flat_deltas.astype(table.dtype), mode="drop"
         )

@@ -29,6 +29,20 @@ the last table row (the unfused path predicts against a clipped row), and
 its lane updates no user row (the unfused path still applies the user
 delta from the clipped pull).
 
+Real-Mosaic layout (measured on a v5e with benchmarks/mosaic_probe.py —
+sub-8-row dynamic VMEM slices and non-128-multiple minor dims are
+rejected by the hardware compiler, which interpreter mode cannot see):
+lanes are processed in GROUPS OF 8 at 8-aligned offsets, the item table
+is read/written in aligned 8-row WINDOWS (item row ``r`` = window
+``r // 8``, slot ``r % 8``), per-lane rows are extracted/placed with
+iota masks and static value slices (never per-lane ref slicing), and
+each group's outputs are written as one aligned (8, d) store.  The
+compiled path requires ``d % 128 == 0`` and ``capacity % 8 == 0``
+(:func:`supports_shape`); callers fall back to the unfused XLA step
+otherwise.  A unique window costs ONE 8-row DMA round trip per
+microbatch, so item-side HBM traffic is O(unique windows) — under Zipf
+skew far below the O(batch) row traversals of the unfused step.
+
 Status: logic-verified in interpreter mode on CPU; chunk size and the
 on-chip win await a live TPU (benchmarks/microbench.py mf_fused).
 """
@@ -43,12 +57,16 @@ import numpy as np
 
 Array = jax.Array
 
+# One measured Mosaic rule, one home: the scatter kernel module owns the
+# window size and shape gate; this kernel shares them.
+from .pallas_scatter import WINDOW, supports_shape  # noqa: E402
+
 
 def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             out_table_ref, udelta_ref, pred_ref,
-            q_ref, acc_ref, carry_ref, row_ref, sem_in, sem_out,
+            win_ref, acc_ref, carry_ref, sem_in, sem_out,
             *, chunk: int, lr: float, reg: float):
-    """One grid step = one chunk of lanes sorted by item id.
+    """One grid step = one chunk of lanes sorted by item id (chunk % 8 == 0).
 
     ids_ref: (N,) int32 SMEM (scalar-prefetched) — sorted item ids.
     p_ref: (chunk, d) VMEM — pre-gathered user rows (f32).
@@ -56,9 +74,10 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
     table_ref/out_table_ref: aliased (capacity, d) HBM item table.
     udelta_ref: (chunk, d) VMEM out — per-lane user deltas (f32).
     pred_ref: (chunk, 1) VMEM out — per-lane predictions (f32).
-    q_ref/row_ref: (1, d) VMEM scratch in table dtype (DMA staging).
-    acc_ref: (1, d) f32 VMEM — current run's item-delta accumulator.
-    carry_ref: (1,) int32 SMEM — current run's item id (-1 = none).
+    win_ref: (8, d) VMEM — the current window's PULLED snapshot (table
+      dtype; all lanes of a window compute against it).
+    acc_ref: (8, d) f32 VMEM — the current window's item-delta sums.
+    carry_ref: (1,) int32 SMEM — current window index (-1 = none).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -71,55 +90,105 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
     def _init():
         carry_ref[0] = -1
         acc_ref[:] = jnp.zeros_like(acc_ref)
-        q_ref[:] = jnp.zeros_like(q_ref)
+        win_ref[:] = jnp.zeros_like(win_ref)
 
-    def flush(row_id):
-        """item_table[row_id] = q + acc (one RMW round trip per run)."""
-        row_ref[:] = (
-            q_ref[:].astype(jnp.float32) + acc_ref[:]
-        ).astype(row_ref.dtype)
+    def flush(w):
+        """item_table[w*8 : w*8+8] = win + acc (one RMW per window)."""
+        win_ref[:] = (
+            win_ref[:].astype(jnp.float32) + acc_ref[:]
+        ).astype(win_ref.dtype)
         dma = pltpu.make_async_copy(
-            row_ref, out_table_ref.at[pl.ds(row_id, 1)], sem_out
+            win_ref, out_table_ref.at[pl.ds(w * WINDOW, WINDOW)], sem_out
         )
         dma.start()
         dma.wait()
 
-    def load(row_id):
+    def load(w):
+        """Pull window w's snapshot (before any of this batch's deltas)."""
         dma = pltpu.make_async_copy(
-            table_ref.at[pl.ds(row_id, 1)], q_ref, sem_in
+            table_ref.at[pl.ds(w * WINDOW, WINDOW)], win_ref, sem_in
         )
         dma.start()
         dma.wait()
 
-    def lane(i, _):
-        idx = base + i
-        it = ids_ref[idx]
-        cur = carry_ref[0]
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (WINDOW, 1), 0)
 
-        @pl.when(jnp.logical_and(it != cur, cur >= 0))
-        def _boundary():
-            flush(cur)
-
-        @pl.when(it != cur)
-        def _new_run():
-            load(it)
+    def switch_window(w):
+        @pl.when(w != carry_ref[0])
+        def _():
+            @pl.when(carry_ref[0] >= 0)
+            def _():
+                flush(carry_ref[0])
+            load(w)
             acc_ref[:] = jnp.zeros_like(acc_ref)
-            carry_ref[0] = it
+            carry_ref[0] = w
 
-        q = q_ref[0, :].astype(jnp.float32)  # pulled snapshot (run-const)
-        p = p_ref[pl.ds(i, 1), :][0, :]
-        m = m_ref[pl.ds(i, 1), :][0, 0]
-        r = r_ref[pl.ds(i, 1), :][0, 0]
-        pred = jnp.sum(p * q)
-        err = r - pred
-        pred_ref[pl.ds(i, 1), :] = pred[None, None]
-        udelta_ref[pl.ds(i, 1), :] = (
-            (m * lr) * (err * q - reg * p)
-        )[None, :]
-        acc_ref[0, :] = acc_ref[0, :] + (m * lr) * (err * p - reg * q)
+    def lane_math(W, P, j, s_j, r_j, m_j):
+        """SGD math for one lane against window snapshot W.
+
+        Returns (pred_row, udelta_row, item_delta_row) as (1, d)/(1, 1)
+        values; the item delta is also accumulated into acc at slot s_j.
+        """
+        sel = (slot_iota == s_j).astype(jnp.float32)  # (8, 1) one-hot
+        q = jnp.sum(sel * W, axis=0, keepdims=True)   # (1, d) win[s_j]
+        p = P[j:j + 1, :]                             # static value slice
+        pred = jnp.sum(p * q, axis=1, keepdims=True)  # (1, 1)
+        e = (m_j * lr) * (r_j - pred)                 # (1, 1)
+        ud = e * q - (m_j * lr * reg) * p             # (1, d)
+        idlt = e * p - (m_j * lr * reg) * q           # (1, d)
+        acc_ref[:] = acc_ref[:] + sel * idlt
+        return pred, ud
+
+    def group(g, _):
+        gbase = base + g * 8
+        P = p_ref[pl.ds(g * 8, 8), :]
+        r_col = r_ref[pl.ds(g * 8, 8), :]
+        m_col = m_ref[pl.ds(g * 8, 8), :]
+        w_first = ids_ref[gbase] // WINDOW
+        w_last = ids_ref[gbase + 7] // WINDOW
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+
+        @pl.when(w_first == w_last)
+        def _one_window():
+            # whole group in one window (sorted ids): one flush check,
+            # then all 8 lanes against the same snapshot
+            switch_window(w_first)
+            W = win_ref[:].astype(jnp.float32)
+            UD = jnp.zeros_like(acc_ref[:])
+            PRED = jnp.zeros((8, 1), jnp.float32)
+            for j in range(8):
+                lane_sel = (lane_iota == j).astype(jnp.float32)
+                pred, ud = lane_math(
+                    W, P, j, ids_ref[gbase + j] % WINDOW,
+                    r_col[j:j + 1, :], m_col[j:j + 1, :],
+                )
+                UD = UD + lane_sel * ud
+                PRED = PRED + lane_sel * pred
+            udelta_ref[pl.ds(g * 8, 8), :] = UD
+            pred_ref[pl.ds(g * 8, 8), :] = PRED
+
+        @pl.when(w_first != w_last)
+        def _boundary_group():
+            # window boundary inside the group: per-lane flush checks;
+            # W re-read per lane because the window can change under us
+            UD = jnp.zeros_like(acc_ref[:])
+            PRED = jnp.zeros((8, 1), jnp.float32)
+            for j in range(8):
+                id_j = ids_ref[gbase + j]
+                switch_window(id_j // WINDOW)
+                lane_sel = (lane_iota == j).astype(jnp.float32)
+                pred, ud = lane_math(
+                    win_ref[:].astype(jnp.float32), P, j, id_j % WINDOW,
+                    r_col[j:j + 1, :], m_col[j:j + 1, :],
+                )
+                UD = UD + lane_sel * ud
+                PRED = PRED + lane_sel * pred
+            udelta_ref[pl.ds(g * 8, 8), :] = UD
+            pred_ref[pl.ds(g * 8, 8), :] = PRED
+
         return 0
 
-    jax.lax.fori_loop(0, chunk, lane, 0)
+    jax.lax.fori_loop(0, chunk // 8, group, 0)
 
     @pl.when(c == num_chunks - 1)
     def _final():
@@ -150,6 +219,24 @@ def _sorted_fused_call(
 
     capacity, dim = item_table.shape
     n_pad = s_items.shape[0]
+    if capacity % WINDOW != 0:
+        # structural for the windowed DMA in EVERY mode: the last window
+        # would overrun (interpret clamps the slice => silent corruption)
+        raise ValueError(
+            f"fused MF pallas kernel needs capacity % {WINDOW} == 0 (the "
+            f"item table is read/written in {WINDOW}-row windows); got "
+            f"{capacity}. Use fused_mf_sgd(), which pads, or align the "
+            f"table (ShardedParamStore does)."
+        )
+    if not interpret and not supports_shape(capacity, dim):
+        raise ValueError(
+            f"fused MF pallas kernel needs dim % 128 == 0 on real Mosaic "
+            f"(lane alignment); got item table ({capacity}, {dim}). "
+            f"Callers should gate on supports_shape() and use the unfused "
+            f"XLA step instead."
+        )
+    if chunk % 8 != 0:
+        raise ValueError(f"chunk must be a multiple of 8, got {chunk}")
 
     if not isinstance(item_table, jax.core.Tracer):
         # eager call: aliasing would invalidate the caller's buffer
@@ -180,10 +267,9 @@ def _sorted_fused_call(
                          memory_space=pltpu.VMEM),  # predictions
         ],
         scratch_shapes=[
-            pltpu.VMEM((1, dim), item_table.dtype),  # q (pulled row)
-            pltpu.VMEM((1, dim), jnp.float32),  # acc
-            pltpu.SMEM((1,), jnp.int32),  # carry id
-            pltpu.VMEM((1, dim), item_table.dtype),  # RMW staging
+            pltpu.VMEM((8, dim), item_table.dtype),  # window snapshot
+            pltpu.VMEM((8, dim), jnp.float32),  # acc (window deltas)
+            pltpu.SMEM((1,), jnp.int32),  # carry window index
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
@@ -274,6 +360,22 @@ def fused_mf_sgd(
         interpret = jax.default_backend() != "tpu"
     n = items.shape[0]
     capacity = item_table.shape[0]
+    cap8 = ((capacity + WINDOW - 1) // WINDOW) * WINDOW
+    if cap8 != capacity:
+        # window-align with a pad copy (correctness path for direct
+        # callers; stores align capacity at create time).  Invalid lanes
+        # are routed against the REAL last row before padding, so the
+        # documented invalid-lane prediction semantics are unchanged.
+        valid = (items >= 0) & (items < capacity)
+        routed = jnp.where(valid, items, capacity - 1)
+        padded = jnp.pad(item_table, ((0, cap8 - capacity), (0, 0)))
+        new_users, new_items, pred = fused_mf_sgd(
+            user_table, padded, users, routed, ratings,
+            valid if mask is None else (mask & valid),
+            learning_rate=learning_rate, regularization=regularization,
+            chunk=chunk, interpret=interpret,
+        )
+        return new_users, new_items[:capacity], pred
     order, s_items, s_users, s_r, s_m, s_p = _sort_pad_lanes(
         capacity, user_table, users, items, ratings, mask, chunk
     )
@@ -362,11 +464,17 @@ def fused_mf_sgd_sharded(
             rows, u_table, b_users, jnp.where(hit, rel, -1), b_ratings,
             m, chunk,
         )
+        rows8 = ((rows + WINDOW - 1) // WINDOW) * WINDOW
+        block = (
+            local_table if rows8 == rows
+            else jnp.pad(local_table, ((0, rows8 - rows), (0, 0)))
+        )
         new_block, udeltas, preds = _sorted_fused_call(
-            local_table, s_items, s_p, s_r, s_m,
+            block, s_items, s_p, s_r, s_m,
             learning_rate=lr, regularization=reg,
             chunk=chunk, interpret=interpret,
         )
+        new_block = new_block[:rows]
         # un-permute to lane order, then assemble across shards: each
         # lane was computed on exactly its item's owning shard (zero
         # elsewhere), so one psum yields the full per-lane values
@@ -451,4 +559,6 @@ __all__ = [
     "fused_mf_sgd",
     "fused_mf_sgd_sharded",
     "make_fused_mf_train_step",
+    "supports_shape",
+    "WINDOW",
 ]

@@ -1,23 +1,40 @@
-"""Pallas TPU kernel: sorted-run scatter-add ("the native component").
+"""Pallas TPU kernel: sorted window scatter-add ("the native component").
 
 Reference parity: SURVEY.md §7 "Hard parts" names sparse scatter-add under
 skewed id distributions (Criteo, word2vec) as the rebuild's native-kernel
 obligation — the role CUDA kernels would play in a GPU framework.
 
-Algorithm (duplicate-compressing read-modify-write):
+Algorithm (duplicate-compressing windowed read-modify-write):
 
   1. XLA-side, sort the (ids, deltas) batch by id — hot ids become
      contiguous *runs*.
-  2. The kernel walks the sorted lanes with a sequential TPU grid; the
-     per-lane ids sit in SMEM via scalar prefetch.  It accumulates each
-     run into a VMEM row register and performs ONE HBM read-modify-write
-     per *unique* id (async DMA row in, vector add, DMA row out) — a
-     Zipf-hot id touching HBM once per microbatch instead of once per
-     occurrence.  XLA's generic scatter serialises every duplicate lane;
-     this kernel's HBM traffic is O(unique) instead of O(batch).
-  3. Run carry state (current id + partial sum) lives in scratch that
-     persists across grid steps (TPU grids execute sequentially), so runs
-     spanning chunk boundaries are handled for free.
+  2. The kernel walks the sorted lanes in GROUPS OF 8 with a sequential
+     TPU grid; per-lane ids sit in SMEM via scalar prefetch.  Table rows
+     are read and written in aligned 8-row WINDOWS (row ``r`` lives in
+     window ``r // 8`` at slot ``r % 8``): the current window's deltas
+     accumulate into an (8, d) f32 register, and each unique window gets
+     ONE HBM read-modify-write (async 8-row DMA in, add, DMA out).  A
+     Zipf-hot id touches HBM once per microbatch instead of once per
+     occurrence, and adjacent hot ids share a window — HBM traffic is
+     O(unique windows) · 8 rows instead of O(batch) serialized rows.
+  3. Lane placement never slices a VMEM ref at a per-lane offset (real
+     Mosaic rejects sub-8-row dynamic slices — see
+     benchmarks/mosaic_probe.py for the measured rules).  A group's 8
+     delta rows are loaded as one aligned (8, d) tile and placed into
+     window slots with an 8×8 one-hot select matmul; groups that sit in
+     a single window (the common case for sorted Zipf ids) take one
+     matmul for all 8 lanes.
+  4. Run carry state (current window + partial sums) lives in scratch
+     that persists across grid steps (TPU grids execute sequentially),
+     so windows spanning chunk boundaries are handled for free.
+
+Mosaic-measured shape requirements for the compiled path (the store and
+the collective plane fall back to XLA scatter — with a warning — when
+they are not met; see :func:`supports_shape`):
+
+  - flattened row width ``d`` must be a multiple of 128 (lane width:
+    dynamic-offset HBM DMAs require 128-aligned minor extents),
+  - table capacity must be a multiple of 8 (windows must not overrun).
 
 ``scatter_add(...)`` is the public wrapper: turns OOB/masked lanes into
 zero-deltas on the last row, sorts, and invokes the kernel with
@@ -39,18 +56,27 @@ import numpy as np
 
 Array = jax.Array
 
+WINDOW = 8  # table rows per DMA window (Mosaic sublane tile)
 
-def _kernel(ids_ref, deltas_ref, table_ref, out_ref, acc_ref, carry_ref,
-            row_ref, sem_in, sem_out, *, chunk: int, dim: int, capacity: int):
-    """One grid step = one chunk of sorted lanes.
+
+def supports_shape(capacity: int, dim: int) -> bool:
+    """True if the compiled kernel supports a (capacity, dim) table."""
+    return dim % 128 == 0 and capacity % WINDOW == 0
+
+
+def _kernel(ids_ref, deltas_ref, table_ref, out_ref,
+            acc_ref, win_ref, carry_ref, sem_in, sem_out, *, chunk: int):
+    """One grid step = one chunk of sorted lanes (chunk % 8 == 0).
 
     ids_ref: (N,) int32 in SMEM (scalar-prefetched, whole batch).
-    deltas_ref: (chunk, dim) VMEM block for this grid step.
-    table_ref/out_ref: aliased (capacity, dim) HBM table (dropped lanes
+    deltas_ref: (chunk, d) VMEM block for this grid step (table dtype).
+    table_ref/out_ref: aliased (capacity, d) HBM table (dropped lanes
       arrive as zero-deltas on the last row, so no sentinel is needed).
-    acc_ref: (1, dim) VMEM — the current run's partial sum.
-    carry_ref: (1,) int32 SMEM — the current run's id (-1 = none).
-    row_ref: (1, dim) VMEM — staging row for the HBM read-modify-write.
+    acc_ref: (8, d) VMEM — the current window's accumulated deltas
+      (f32 for float tables; table dtype for integer tables, where an
+      f32 round trip would drop increments past 2**24).
+    win_ref: (8, d) VMEM staging window for the HBM read-modify-write.
+    carry_ref: (1,) int32 SMEM — the current window index (-1 = none).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -58,46 +84,79 @@ def _kernel(ids_ref, deltas_ref, table_ref, out_ref, acc_ref, carry_ref,
     c = pl.program_id(0)
     num_chunks = pl.num_programs(0)
     base = c * chunk
-    n_total = ids_ref.shape[0]
 
     @pl.when(c == 0)
     def _init():
         carry_ref[0] = -1
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def flush(row_id):
-        """table[row_id] += acc (one RMW round trip)."""
+    def flush(w):
+        """table[w*8 : w*8+8] += acc (one 8-row RMW round trip)."""
         dma_in = pltpu.make_async_copy(
-            table_ref.at[pl.ds(row_id, 1)], row_ref, sem_in
+            table_ref.at[pl.ds(w * WINDOW, WINDOW)], win_ref, sem_in
         )
         dma_in.start()
         dma_in.wait()
-        row_ref[:] = row_ref[:] + acc_ref[:]
+        win_ref[:] = (
+            win_ref[:].astype(acc_ref.dtype) + acc_ref[:]
+        ).astype(win_ref.dtype)
         dma_out = pltpu.make_async_copy(
-            row_ref, out_ref.at[pl.ds(row_id, 1)], sem_out
+            win_ref, out_ref.at[pl.ds(w * WINDOW, WINDOW)], sem_out
         )
         dma_out.start()
         dma_out.wait()
 
-    def lane(i, _):
-        idx = base + i
-        lane_id = ids_ref[idx]
-        cur = carry_ref[0]
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, (WINDOW, 1), 0)
 
-        @pl.when(jnp.logical_and(cur != lane_id, cur >= 0))
-        def _boundary():
-            flush(cur)
+    def place(G, j, s_j):
+        """acc[s_j, :] += G[j, :] — static row slice + iota-mask
+        broadcast (exact VPU ops; no per-lane VMEM slicing)."""
+        row = G[j:j + 1, :]  # static slice of a loaded value
+        sel = (slot_iota == s_j).astype(acc_ref.dtype)  # (8, 1) one-hot
+        acc_ref[:] = acc_ref[:] + sel * row
 
-        @pl.when(cur != lane_id)
-        def _new_run():
-            acc_ref[:] = jnp.zeros_like(acc_ref)
-            carry_ref[0] = lane_id
+    def group(g, _):
+        gbase = base + g * 8
+        G = deltas_ref[pl.ds(g * 8, 8), :].astype(acc_ref.dtype)
+        w_first = ids_ref[gbase] // WINDOW
+        w_last = ids_ref[gbase + 7] // WINDOW
 
-        acc_ref[:] = acc_ref[:] + deltas_ref[pl.ds(i, 1), :]
+        @pl.when(w_first == w_last)
+        def _one_window():
+            # the whole group lands in one window (sorted ids): one
+            # flush check for all 8 lanes
+            @pl.when(w_first != carry_ref[0])
+            def _switch():
+                @pl.when(carry_ref[0] >= 0)
+                def _():
+                    flush(carry_ref[0])
+                acc_ref[:] = jnp.zeros_like(acc_ref)
+                carry_ref[0] = w_first
+
+            for j in range(8):
+                place(G, j, ids_ref[gbase + j] % WINDOW)
+
+        @pl.when(w_first != w_last)
+        def _boundary_group():
+            # window boundary inside the group: place lanes one at a
+            # time with flush checks (rare — at most once per window)
+            for j in range(8):
+                id_j = ids_ref[gbase + j]
+                w_j = id_j // WINDOW
+
+                @pl.when(w_j != carry_ref[0])
+                def _switch(w_j=w_j):
+                    @pl.when(carry_ref[0] >= 0)
+                    def _():
+                        flush(carry_ref[0])
+                    acc_ref[:] = jnp.zeros_like(acc_ref)
+                    carry_ref[0] = w_j
+
+                place(G, j, id_j % WINDOW)
+
         return 0
 
-    n_here = jnp.minimum(chunk, n_total - base)
-    jax.lax.fori_loop(0, n_here, lane, 0)
+    jax.lax.fori_loop(0, chunk // 8, group, 0)
 
     @pl.when(c == num_chunks - 1)
     def _final():
@@ -120,11 +179,30 @@ def sorted_scatter_add_pallas(
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    n, dim = sorted_deltas.shape
+    capacity = table.shape[0]
+    if capacity % WINDOW != 0:
+        # structural for the windowed DMA in EVERY mode: the last window
+        # would overrun (interpret clamps the slice => silent corruption)
+        raise ValueError(
+            f"pallas scatter kernel needs capacity % {WINDOW} == 0 (the "
+            f"table is read/written in {WINDOW}-row windows); got "
+            f"{capacity}. Use scatter_add(), which pads, or align the "
+            f"table (ShardedParamStore does)."
+        )
+    if not interpret and not supports_shape(capacity, dim):
+        raise ValueError(
+            f"pallas scatter kernel needs dim % 128 == 0 on real Mosaic "
+            f"(lane alignment); got table ({capacity}, {dim}). Callers "
+            f"should gate on supports_shape() and use the XLA scatter "
+            f"path instead."
+        )
+    if chunk % 8 != 0:
+        raise ValueError(f"chunk must be a multiple of 8, got {chunk}")
+
     if not isinstance(table, jax.core.Tracer):
         table = jnp.copy(table)
 
-    n, dim = sorted_deltas.shape
-    capacity = table.shape[0]
     n_pad = ((n + chunk - 1) // chunk) * chunk
     if n_pad != n:
         # pad with zero-deltas onto the last row (largest id keeps the
@@ -137,9 +215,7 @@ def sorted_scatter_add_pallas(
         )
 
     grid = (n_pad // chunk,)
-    kernel = functools.partial(
-        _kernel, chunk=chunk, dim=dim, capacity=capacity
-    )
+    kernel = functools.partial(_kernel, chunk=chunk)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
@@ -152,9 +228,14 @@ def sorted_scatter_add_pallas(
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pltpu.VMEM((1, dim), table.dtype),  # acc
-            pltpu.SMEM((1,), jnp.int32),  # carry id
-            pltpu.VMEM((1, dim), table.dtype),  # RMW staging row
+            pltpu.VMEM(
+                (WINDOW, dim),
+                jnp.float32
+                if jnp.issubdtype(table.dtype, jnp.floating)
+                else table.dtype,
+            ),  # acc
+            pltpu.VMEM((WINDOW, dim), table.dtype),  # RMW staging window
+            pltpu.SMEM((1,), jnp.int32),  # carry window index
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
@@ -181,11 +262,23 @@ def scatter_add(
 
     Drop-in replacement for the XLA ``.at[].add`` path in
     :func:`..core.store.push` (OOB/masked lanes dropped).  Sorts by id,
-    then one HBM read-modify-write per unique id.
+    then one 8-row-window HBM read-modify-write per unique window.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     capacity, dim = table.shape[0], int(np.prod(table.shape[1:]))
+    cap8 = ((capacity + WINDOW - 1) // WINDOW) * WINDOW
+    if cap8 != capacity:
+        # window-align with a pad copy (correctness path for direct
+        # callers; ShardedParamStore aligns capacity at create time so
+        # the store's perf path never takes this)
+        padded = jnp.pad(
+            table.reshape(capacity, dim), ((0, cap8 - capacity), (0, 0))
+        )
+        out = scatter_add(
+            padded, ids, deltas, mask, chunk=chunk, interpret=interpret
+        )
+        return out[:capacity].reshape(table.shape)
     flat_ids = ids.reshape(-1).astype(jnp.int32)
     flat_deltas = deltas.reshape(-1, dim)
     oob = (flat_ids < 0) | (flat_ids >= capacity)
@@ -194,7 +287,9 @@ def scatter_add(
     # Dropped lanes become zero-deltas on the last row (no sentinel row —
     # avoiding a full-table concatenate+slice copy per push).
     work_ids = jnp.where(oob, capacity - 1, flat_ids)
-    flat_deltas = jnp.where(oob[:, None], 0.0, flat_deltas)
+    flat_deltas = jnp.where(
+        oob[:, None], jnp.zeros_like(flat_deltas), flat_deltas
+    )
     order = jnp.argsort(work_ids)
     sorted_ids = jnp.take(work_ids, order)
     sorted_deltas = jnp.take(flat_deltas, order, axis=0)
@@ -205,4 +300,5 @@ def scatter_add(
     return out.reshape(table.shape)
 
 
-__all__ = ["scatter_add", "sorted_scatter_add_pallas"]
+__all__ = ["scatter_add", "sorted_scatter_add_pallas", "supports_shape",
+           "WINDOW"]

@@ -24,6 +24,7 @@ an affine id-permutation to spread them.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -107,6 +108,28 @@ def shard_push_add(
     read-modify-write per unique local row under Zipf-hot ids.
     """
     value_rank = table.ndim - 1
+    if impl == "pallas":
+        # Real Mosaic's measured shape rules (benchmarks/mosaic_probe.py):
+        # compiled kernels need 128-aligned row widths and 8-aligned
+        # per-shard capacities.  Fall back observably, never silently.
+        from ..ops.pallas_scatter import supports_shape
+
+        rows_per_shard = table.shape[0] // mesh.shape[ps_axis]
+        row_width = 1
+        for s in table.shape[1:]:
+            row_width *= s
+        if jax.default_backend() == "tpu" and not supports_shape(
+            rows_per_shard, row_width
+        ):
+            warnings.warn(
+                f"shard_push_add impl='pallas' falling back to XLA "
+                f"scatter: per-shard table ({rows_per_shard}, {row_width}) "
+                f"violates Mosaic alignment (need rows % 8 == 0, "
+                f"width % 128 == 0)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            impl = "xla"
     vspec = (None,) * value_rank
     table_spec = P(ps_axis, *vspec)
     lead = P(dp_axis) if dp_axis else P(None)

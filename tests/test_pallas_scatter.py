@@ -22,7 +22,7 @@ def _oracle(table, ids, deltas, mask=None):
     return out
 
 
-@pytest.mark.parametrize("chunk", [4, 16, 512])
+@pytest.mark.parametrize("chunk", [8, 16, 512])
 def test_matches_oracle_random(chunk):
     rng = np.random.default_rng(0)
     table = jnp.asarray(rng.normal(0, 1, (32, 8)).astype(np.float32))
@@ -52,7 +52,7 @@ def test_mask_and_oob_dropped():
     ids = jnp.asarray([0, -2, 99, 5, 5], jnp.int32)
     deltas = jnp.asarray(rng.normal(0, 1, (5, 4)).astype(np.float32))
     mask = jnp.asarray([True, True, True, True, False])
-    got = scatter_add(table, ids, deltas, mask, chunk=4, interpret=True)
+    got = scatter_add(table, ids, deltas, mask, chunk=8, interpret=True)
     np.testing.assert_allclose(
         np.asarray(got), _oracle(table, ids, deltas, mask), rtol=1e-5, atol=1e-5
     )
@@ -112,3 +112,34 @@ def test_store_pallas_impl_sharded_mesh(mesh):
         np.asarray(a.values()), np.asarray(b.values()), rtol=1e-5, atol=1e-5
     )
     assert "ps" in str(b.table.sharding.spec)
+
+
+def test_integer_table_exact_past_f32_mantissa():
+    """Integer tables must accumulate in table dtype: an f32 round trip
+    would silently drop +1 increments on counts above 2**24."""
+    big = 20_000_000  # > 2**24: not representable +1 in f32
+    table = jnp.full((8, 128), big, jnp.int32)
+    ids = jnp.zeros((16,), jnp.int32)
+    deltas = jnp.ones((16, 128), jnp.int32)
+    out = scatter_add(table, ids, deltas, chunk=8, interpret=True)
+    assert int(out[0, 0]) == big + 16
+    assert int(out[1, 0]) == big
+
+
+def test_unaligned_capacity_raises_in_core_but_pads_in_wrapper():
+    """sorted_scatter_add_pallas must refuse capacity % 8 != 0 in every
+    mode (the windowed DMA would overrun and silently corrupt rows);
+    scatter_add pads and stays correct."""
+    from flink_parameter_server_tpu.ops.pallas_scatter import (
+        sorted_scatter_add_pallas,
+    )
+
+    table = jnp.zeros((30, 128), jnp.float32)
+    ids = jnp.asarray([29, 29, 3], jnp.int32)
+    deltas = jnp.ones((3, 128), jnp.float32)
+    with pytest.raises(ValueError, match="capacity % 8"):
+        sorted_scatter_add_pallas(
+            table, jnp.sort(ids), deltas, chunk=8, interpret=True
+        )
+    out = scatter_add(table, ids, deltas, chunk=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _oracle(table, ids, deltas))
