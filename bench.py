@@ -135,6 +135,26 @@ def tpu_updates_per_sec(
     # (interpret mode on CPU is not a perf number — flag ignored there)
     fused = fused_requested and jax.default_backend() == "tpu"
 
+    # FPS_BENCH_SCATTER=pallas + FPS_BENCH_LAYOUT=packed: the sorted-
+    # window kernel on a lane-packed table (the TPU-native path for the
+    # reference's narrow dim-64 rows; ops/packed.py).
+    scatter_impl = os.environ.get("FPS_BENCH_SCATTER", "xla")
+    layout = os.environ.get("FPS_BENCH_LAYOUT", "dense")
+    if scatter_impl not in ("xla", "pallas"):
+        raise SystemExit(f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas")
+    if layout not in ("dense", "packed", "auto"):
+        raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
+    if scatter_impl == "pallas" and jax.default_backend() != "tpu":
+        # interpreter-mode pallas at bench batch sizes would wedge the
+        # CPU-fallback run — the exact failure the fallback exists to
+        # prevent (criteo_stress has the same guard)
+        print(
+            "# no TPU: FPS_BENCH_SCATTER=pallas would run interpreted; "
+            "using xla",
+            file=sys.stderr,
+        )
+        scatter_impl = "xla"
+
     # lr matches cpu_per_record_baseline (both sides numerically stable).
     logic = OnlineMatrixFactorization(
         num_users, dim, updater=SGDUpdater(0.01), dtype=dtype, mesh=mesh
@@ -142,6 +162,7 @@ def tpu_updates_per_sec(
     store = ShardedParamStore.create(
         num_items, (dim,), dtype=dtype,
         init_fn=normal_factor(1, (dim,), dtype=dtype), mesh=mesh,
+        scatter_impl=scatter_impl, layout=layout,
     )
     state = logic.init_state(jax.random.PRNGKey(0))
 
@@ -216,13 +237,21 @@ def tpu_updates_per_sec(
     # once (1 read + 1 write) and the sort adds ~2 permute passes over
     # the id/lane arrays; the user side is unchanged.
     el = jnp.dtype(dtype).itemsize
+    # the packed layout moves full physical rows (128 lanes) per
+    # pull/push regardless of the logical dim
+    if store.spec.layout == "packed":
+        from flink_parameter_server_tpu.ops.packed import phys_width
+
+        row_lanes = phys_width(dim)
+    else:
+        row_lanes = dim
     if fused:
         hbm_bytes_per_step = (
-            (3 * batch + 2 * unique_items) * dim * el  # rows
+            (3 * batch + 2 * unique_items) * row_lanes * el  # rows
             + 8 * batch * 4  # id sort/permute passes (int32)
         )
     else:
-        hbm_bytes_per_step = 6 * batch * dim * el
+        hbm_bytes_per_step = 6 * batch * row_lanes * el
     step_time = dt / bench_steps
     peak = _hbm_peak_bytes_per_sec()
     bandwidth_util = (
@@ -236,6 +265,9 @@ def tpu_updates_per_sec(
         "hbm_bytes_per_step": hbm_bytes_per_step,
         "bandwidth_util": bandwidth_util,
         "fused_step": fused,
+        "dim": dim,
+        "scatter_impl": scatter_impl,
+        "layout": layout,
     }
 
 
@@ -302,7 +334,7 @@ def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
     r = tpu_updates_per_sec()
-    cpu_rate, baseline_finite = cpu_per_record_baseline()
+    cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
     metric = "MF-SGD updates/sec/chip (synthetic MovieLens-like, Zipf items)"
     if fallback:
         metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
@@ -329,6 +361,9 @@ def main():
                     "hbm_bytes_per_step": r["hbm_bytes_per_step"],
                     "bandwidth_util": round(util, 4) if util else None,
                     "fused_step": r["fused_step"],
+                    "dim": r["dim"],
+                    "scatter_impl": r["scatter_impl"],
+                    "layout": r["layout"],
                 },
             }
         )

@@ -40,6 +40,12 @@ def main():
         "--scatter", default="pallas", choices=["pallas", "xla"]
     )
     ap.add_argument(
+        "--layout", default="packed", choices=["packed", "dense"],
+        help="packed = k narrow rows per 128-lane physical row "
+        "(ops/packed.py) — required for the pallas kernel at FM's "
+        "17-wide rows on real Mosaic",
+    )
+    ap.add_argument(
         "--cpu-scale", action="store_true",
         help="shrink shapes for the 1-core dev host (harness proof only)",
     )
@@ -92,13 +98,14 @@ def main():
     t0 = time.perf_counter()
     store = ShardedParamStore.create(
         F, (1 + dim,), dtype=dtype, init_fn=init,
-        scatter_impl=args.scatter,
+        scatter_impl=args.scatter, layout=args.layout,
     )
     jax.block_until_ready(store.table)
     t_init = time.perf_counter() - t0
     table_bytes = store.table.nbytes
     print(
-        f"# table {F:,} x {1+dim} bf16 = {table_bytes/2**30:.2f} GiB, "
+        f"# table {F:,} x {1+dim} bf16 = {table_bytes/2**30:.2f} GiB "
+        f"({args.layout} layout, phys {store.table.shape}), "
         f"init {t_init:.1f}s", file=sys.stderr,
     )
 
@@ -139,10 +146,15 @@ def main():
     dt = time.perf_counter() - t0
 
     # numeric health: the Zipf head rows take the most updates — sample
-    # the head and a random slice, all must be finite in bf16
-    head = np.asarray(table[:4096].astype(jnp.float32))
-    tail_ix = rng.integers(0, F, 4096)
-    tail = np.asarray(table[tail_ix].astype(jnp.float32))
+    # the head and a random slice, all must be finite in bf16.  Sample
+    # through pull() (LOGICAL ids): raw physical-table indexing would
+    # clamp most logical ids under the packed layout and silently
+    # re-check one row.
+    end_store = ShardedParamStore(store.spec, table)
+    head_ix = jnp.arange(4096, dtype=jnp.int32)
+    tail_ix = jnp.asarray(rng.integers(0, F, 4096).astype(np.int32))
+    head = np.asarray(end_store.pull(head_ix).astype(jnp.float32))
+    tail = np.asarray(end_store.pull(tail_ix).astype(jnp.float32))
     finite_frac = float(
         np.mean(np.isfinite(head)) * 0.5 + np.mean(np.isfinite(tail)) * 0.5
     )

@@ -71,14 +71,28 @@ def main():
     py = sys.executable
     results = []
 
-    # 1. headline bench, bf16, batch sweep — unfused AND fused step
+    # 1. headline bench, bf16, batch sweep — three step variants:
+    #    unfused-xla (the r2 headline), pallas-packed scatter at the
+    #    native dim 64 (ops/packed.py), and the fused kernel at dim 128.
+    # every variant pins ALL four knobs — an ambient FPS_BENCH_* export
+    # must never silently relabel an A/B arm
+    variants = (
+        ("unfused", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                     "FPS_BENCH_SCATTER": "xla",
+                     "FPS_BENCH_LAYOUT": "dense"}),
+        ("packed_pallas", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                           "FPS_BENCH_SCATTER": "pallas",
+                           "FPS_BENCH_LAYOUT": "packed"}),
+        ("fused_d128", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "128",
+                        "FPS_BENCH_SCATTER": "xla",
+                        "FPS_BENCH_LAYOUT": "dense"}),
+    )
     for batch in (16_384, 65_536, 262_144):
-        for fused in ("0", "1"):
+        for tag, extra_env in variants:
             env = dict(os.environ)
             env["FPS_BENCH_BATCH"] = str(batch)
             env["FPS_BENCH_DTYPE"] = "bfloat16"
-            env["FPS_BENCH_FUSED"] = fused
-            tag = "fused" if fused == "1" else "unfused"
+            env.update(extra_env)
             results.append(
                 run_job(
                     f"bench_b{batch}_{tag}",

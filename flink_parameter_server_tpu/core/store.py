@@ -65,6 +65,32 @@ def _note_pallas_fallback(reason: str) -> None:
     )
 
 
+def _resolve_layout(
+    layout: str, update: Union[str, UpdateFn], value_shape: Tuple[int, ...]
+) -> str:
+    """Resolve the table layout, validating packed-layout constraints.
+
+    ``"auto"`` picks packed for narrow-row add-stores (the shapes where
+    lane packing pays — MF/FM/PA) and dense otherwise."""
+    if layout not in ("dense", "packed", "auto"):
+        raise ValueError(
+            f"layout must be 'dense', 'packed' or 'auto', got {layout!r}"
+        )
+    width = 1
+    for s in value_shape:
+        width *= int(s)
+    if layout == "auto":
+        return "packed" if (update == "add" and width < 128) else "dense"
+    if layout == "packed" and update != "add":
+        # the generic update path applies `update` per logical row on a
+        # dense combined table — packing it would need an unpack per push
+        raise ValueError(
+            "layout='packed' requires update='add' (custom update "
+            "functions take the dense per-row path)"
+        )
+    return layout
+
+
 @dataclasses.dataclass(frozen=True)
 class StoreSpec:
     """Static configuration of a parameter store (not a pytree leaf)."""
@@ -82,6 +108,12 @@ class StoreSpec:
     scatter_impl: str = "xla"
     mesh: Optional[Mesh] = None
     ps_axis: str = "ps"
+    # "dense": one logical row per physical row (the trivial layout).
+    # "packed": k = 128 // row_width logical rows per 128-lane physical
+    #   row (ops/packed.py) — the TPU-native layout for narrow values
+    #   (MF dim 64, FM dim 17): full vector lanes on every pull/push and
+    #   pallas-kernel eligibility at any width.  Requires update="add".
+    layout: str = "dense"
 
     @property
     def num_shards(self) -> int:
@@ -90,23 +122,55 @@ class StoreSpec:
         return self.mesh.shape[self.ps_axis]
 
     @property
+    def row_width(self) -> int:
+        w = 1
+        for s in self.value_shape:
+            w *= int(s)
+        return w
+
+    @property
+    def pack(self) -> int:
+        """Logical rows per physical row (1 for the dense layout)."""
+        if self.layout != "packed":
+            return 1
+        from ..ops.packed import pack_k
+
+        return pack_k(self.row_width)
+
+    @property
     def rows_per_shard(self) -> int:
-        """Per-shard row count, window-aligned for the pallas kernel.
+        """Per-shard PHYSICAL row count, window-aligned for the pallas
+        kernel.
 
         Real Mosaic reads/writes the table in aligned 8-row windows
         (ops/pallas_scatter.WINDOW); aligning every shard's block here
         means the kernel path never needs a pad-copy of the table."""
         n = self.num_shards
-        per = (self.capacity + n - 1) // n
+        logical = (self.capacity + self.pack - 1) // self.pack
+        per = (logical + n - 1) // n
         return ((per + 7) // 8) * 8
 
     @property
     def padded_capacity(self) -> int:
-        return self.rows_per_shard * self.num_shards
+        """LOGICAL capacity including padding rows (init'd, addressable)."""
+        return self.rows_per_shard * self.num_shards * self.pack
+
+    def table_shape(self) -> Tuple[int, ...]:
+        """Shape of the physical table array."""
+        if self.layout == "packed":
+            from ..ops.packed import phys_width
+
+            return (
+                self.rows_per_shard * self.num_shards,
+                phys_width(self.row_width),
+            )
+        return (self.padded_capacity,) + self.value_shape
 
     def sharding(self) -> Optional[NamedSharding]:
         if self.mesh is None:
             return None
+        if self.layout == "packed":
+            return NamedSharding(self.mesh, P(self.ps_axis, None))
         return NamedSharding(
             self.mesh, P(self.ps_axis, *([None] * len(self.value_shape)))
         )
@@ -131,7 +195,15 @@ def create_table(spec: StoreSpec, init_fn: Optional[InitFn] = None) -> Array:
     out_sharding = spec.sharding()
 
     def build(ids):
-        return init_fn(ids)
+        values = init_fn(ids)
+        if spec.layout == "packed":
+            from ..ops.packed import pack_table
+
+            values = pack_table(
+                values.reshape(-1, spec.row_width),
+                spec.rows_per_shard * spec.num_shards,
+            )
+        return values
 
     if out_sharding is not None:
         build = jax.jit(build, out_shardings=out_sharding)
@@ -143,8 +215,15 @@ def create_table(spec: StoreSpec, init_fn: Optional[InitFn] = None) -> Array:
 def pull(spec: StoreSpec, table: Array, ids: Array) -> Array:
     """Batched pull: ``values[i] = table[ids[i]]`` (sharded gather).
 
-    Out-of-range ids are clipped (callers use a validity mask alongside)."""
+    Out-of-range ids are clipped (callers use a validity mask alongside).
+    Packed layout: one physical-row gather + one lane slice (both
+    vectorized XLA gathers — see ops/packed.py)."""
     ids = jnp.clip(ids.astype(jnp.int32), 0, spec.padded_capacity - 1)
+    if spec.layout == "packed":
+        from ..ops.packed import packed_pull
+
+        vals = packed_pull(table, ids.reshape(-1), spec.row_width)
+        return vals.reshape(ids.shape + spec.value_shape)
     return jnp.take(table, ids, axis=0)
 
 
@@ -196,6 +275,24 @@ def push(
         )
 
     if spec.update == "add":
+        scatter_ids, scatter_deltas, scatter_mask = (
+            flat_ids,
+            flat_deltas,
+            None if mask is None else flat_mask,
+        )
+        if spec.layout == "packed":
+            # Physical-row granularity: lane-shift each delta to its
+            # sub-row offset, scatter at phys ids.  Masked lanes carry
+            # zero deltas already (zeroed above) — no mask needed.
+            from ..ops.packed import lane_shift_deltas
+
+            scatter_deltas = lane_shift_deltas(
+                flat_deltas.reshape(-1, spec.row_width).astype(table.dtype),
+                flat_ids,
+                spec.row_width,
+            )
+            scatter_ids = flat_ids // spec.pack
+            scatter_mask = None
         if spec.scatter_impl == "pallas":
             from ..ops import pallas_scatter as _pallas
 
@@ -203,20 +300,22 @@ def push(
             # (dim % 128, capacity % 8 — measured, see
             # benchmarks/mosaic_probe.py).  Interpreter mode (non-TPU)
             # has no dim constraint; capacity is window-aligned by
-            # rows_per_shard either way.
-            row_width = int(np.prod(spec.value_shape)) if spec.value_shape else 1
+            # rows_per_shard either way.  The packed layout is always
+            # eligible (width 128 by construction).
+            scatter_width = int(
+                np.prod(scatter_deltas.shape[1:])
+            ) if scatter_deltas.ndim > 1 else 1
             shapes_ok = jax.default_backend() != "tpu" or _pallas.supports_shape(
-                spec.rows_per_shard, row_width
+                spec.rows_per_shard, scatter_width
             )
             if not shapes_ok:
                 _note_pallas_fallback(
-                    f"table row width {row_width} not a multiple of 128 "
-                    f"(Mosaic lane alignment)"
+                    f"table row width {scatter_width} not a multiple of 128 "
+                    f"(Mosaic lane alignment; use layout='packed')"
                 )
             elif spec.num_shards == 1:
                 return _pallas.scatter_add(
-                    table, flat_ids, flat_deltas,
-                    None if mask is None else flat_mask,
+                    table, scatter_ids, scatter_deltas, scatter_mask,
                 )
             else:
                 # Sharded: run the kernel per ps shard under shard_map
@@ -232,14 +331,14 @@ def push(
                     if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
                     else None
                 )
-                n = flat_ids.shape[0]
+                n = scatter_ids.shape[0]
                 if dp_axis is None or n % mesh.shape[dp_axis] == 0:
                     # mask=None: masked lanes' deltas were zeroed above,
                     # so a no-op under add — skip the extra mask all_gather
                     return shard_push_add(
                         table,
-                        flat_ids,
-                        flat_deltas,
+                        scatter_ids,
+                        scatter_deltas,
                         None,
                         mesh=mesh,
                         ps_axis=spec.ps_axis,
@@ -249,8 +348,8 @@ def push(
                 _note_pallas_fallback(
                     f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
                 )
-        return table.at[flat_ids].add(
-            flat_deltas.astype(table.dtype), mode="drop"
+        return table.at[scatter_ids].add(
+            scatter_deltas.astype(table.dtype), mode="drop"
         )
 
     # Generic path: combine duplicates densely, then apply `update` once per
@@ -298,6 +397,7 @@ class ShardedParamStore:
         scatter_impl: str = "xla",
         mesh: Optional[Mesh] = None,
         ps_axis: str = "ps",
+        layout: str = "dense",
     ) -> "ShardedParamStore":
         spec = StoreSpec(
             capacity=capacity,
@@ -307,6 +407,7 @@ class ShardedParamStore:
             scatter_impl=scatter_impl,
             mesh=mesh,
             ps_axis=ps_axis,
+            layout=_resolve_layout(layout, update, tuple(value_shape)),
         )
         return cls(spec, create_table(spec, init_fn))
 
@@ -319,6 +420,7 @@ class ShardedParamStore:
         scatter_impl: str = "xla",
         mesh: Optional[Mesh] = None,
         ps_axis: str = "ps",
+        layout: str = "dense",
     ) -> "ShardedParamStore":
         """Seed the store from an existing ``(capacity, *value_shape)``
         array — the reference's ``transformWithModelLoad`` analogue
@@ -331,6 +433,7 @@ class ShardedParamStore:
             scatter_impl=scatter_impl,
             mesh=mesh,
             ps_axis=ps_axis,
+            layout=_resolve_layout(layout, update, tuple(values.shape[1:])),
         )
         return cls(spec, cls._place(spec, values))
 
@@ -351,6 +454,13 @@ class ShardedParamStore:
             values = jnp.concatenate(
                 [values, jnp.zeros((pad,) + spec.value_shape, spec.dtype)]
             )
+        if spec.layout == "packed":
+            from ..ops.packed import pack_table
+
+            values = pack_table(
+                values.reshape(-1, spec.row_width),
+                spec.rows_per_shard * spec.num_shards,
+            )
         sharding = spec.sharding()
         if sharding is not None:
             values = jax.device_put(values, sharding)
@@ -368,8 +478,15 @@ class ShardedParamStore:
         )
 
     def values(self) -> Array:
-        """Final model dump (unpadded) — the reference's close()-time
-        parameter flush (SURVEY.md §3.5)."""
+        """Final model dump (unpadded, LOGICAL layout) — the reference's
+        close()-time parameter flush (SURVEY.md §3.5)."""
+        if self.spec.layout == "packed":
+            from ..ops.packed import unpack_table
+
+            vals = unpack_table(
+                self.table, self.spec.capacity, self.spec.row_width
+            )
+            return vals.reshape((self.spec.capacity,) + self.spec.value_shape)
         return self.table[: self.spec.capacity]
 
     # -- pytree plumbing ---------------------------------------------------

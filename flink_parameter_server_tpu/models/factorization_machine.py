@@ -93,17 +93,26 @@ class FactorizationMachine(BatchedWorkerLogic):
 
 
 def make_store(
-    config: FMConfig, *, seed: int = 0, init_stddev: float = 0.01, mesh=None
+    config: FMConfig, *, seed: int = 0, init_stddev: float = 0.01, mesh=None,
+    dtype=None, scatter_impl: str = "xla", layout: str = "dense",
 ) -> ShardedParamStore:
-    """(num_features, 1+dim) store: w zero-init, v ~ N(0, init_stddev)."""
-    vinit = normal_factor(seed, (config.dim,), stddev=init_stddev)
+    """(num_features, 1+dim) store: w zero-init, v ~ N(0, init_stddev).
+
+    The FM row is NARROW (1+dim = 17 for Criteo shapes) — on TPU pass
+    ``layout="packed"`` (or "auto") to pack 7 rows per 128-lane physical
+    row: full vector lanes and pallas-scatter eligibility
+    (ops/packed.py)."""
+    dtype = dtype or jnp.float32
+    vinit = normal_factor(seed, (config.dim,), stddev=init_stddev,
+                          dtype=dtype)
 
     def init(ids: Array) -> Array:
         v = vinit(ids)
         return jnp.concatenate([jnp.zeros(ids.shape + (1,), v.dtype), v], axis=-1)
 
     return ShardedParamStore.create(
-        config.num_features, (1 + config.dim,), init_fn=init, mesh=mesh
+        config.num_features, (1 + config.dim,), init_fn=init, mesh=mesh,
+        dtype=dtype, scatter_impl=scatter_impl, layout=layout,
     )
 
 

@@ -29,8 +29,15 @@ def _ocp():
 
 
 def _make_payload(store, worker_state, step, extra):
+    # The payload table is in LOGICAL row order: dense stores pass the
+    # padded table straight through (zero-copy per-shard save — restore
+    # slices to `capacity`); packed stores unpack first (the physical
+    # 128-lane layout is an on-device detail, not a portable format).
+    table = (
+        store.values() if store.spec.layout == "packed" else store.table
+    )
     return {
-        "table": store.table,
+        "table": table,
         "worker_state": worker_state if worker_state is not None else (),
         "meta": {
             "step": step,

@@ -1,0 +1,162 @@
+"""Lane-packed store layout (ops/packed.py + StoreSpec.layout="packed").
+
+The packed layout must be OBSERVATIONALLY IDENTICAL to the dense layout
+through the whole store protocol (pull / push / values / checkpoint) —
+it is purely a physical-layout change (k narrow rows per 128-lane
+physical row) that buys full vector lanes and pallas-kernel eligibility
+for the reference's narrow value shapes (MF dim 64, FM dim 17, PA
+scalars).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.ops.packed import (
+    lane_shift_deltas,
+    pack_k,
+    pack_table,
+    packed_pull,
+    unpack_table,
+)
+
+
+def _rand_init(dim):
+    def init(ids):
+        # deterministic per id, shape (n, dim)
+        base = (ids[:, None] * 31 + jnp.arange(dim)[None, :] * 7) % 13
+        return (base.astype(jnp.float32) - 6.0) / 10.0
+
+    return init
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    for cap, d in [(10, 17), (64, 64), (7, 1), (5, 128), (3, 200)]:
+        v = jnp.asarray(rng.normal(0, 1, (cap, d)).astype(np.float32))
+        packed = pack_table(v)
+        assert packed.shape[1] % 128 == 0
+        out = unpack_table(packed, cap, d)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(v))
+
+
+def test_packed_pull_matches_take():
+    rng = np.random.default_rng(1)
+    cap, d = 50, 17
+    v = jnp.asarray(rng.normal(0, 1, (cap, d)).astype(np.float32))
+    packed = pack_table(v)
+    ids = jnp.asarray(rng.integers(0, cap, 200).astype(np.int32))
+    got = packed_pull(packed, ids, d)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(v)[np.asarray(ids)])
+
+
+def test_lane_shift_scatter_equivalence():
+    """scatter-add at phys granularity == logical scatter-add."""
+    rng = np.random.default_rng(2)
+    cap, d, n = 40, 17, 300
+    k = pack_k(d)
+    v = jnp.asarray(rng.normal(0, 1, (cap, d)).astype(np.float32))
+    ids = jnp.asarray(rng.integers(0, cap, n).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32))
+    packed = pack_table(v)
+    shifted = lane_shift_deltas(deltas, ids, d)
+    new_packed = packed.at[ids // k].add(shifted)
+    out = unpack_table(new_packed, cap, d)
+    ref = v.at[ids].add(deltas)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("d,impl", [(17, "xla"), (17, "pallas"),
+                                    (64, "pallas"), (1, "xla")])
+def test_packed_store_matches_dense(d, impl):
+    rng = np.random.default_rng(3)
+    cap, n = 61, 400
+    init = _rand_init(d)
+    dense = ShardedParamStore.create(
+        cap, (d,), init_fn=init, scatter_impl=impl, layout="dense"
+    )
+    packed = ShardedParamStore.create(
+        cap, (d,), init_fn=init, scatter_impl=impl, layout="packed"
+    )
+    assert packed.table.shape[1] % 128 == 0
+    ids = jnp.asarray(rng.integers(-3, cap + 3, n).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32))
+    mask = jnp.asarray(rng.random(n) > 0.2)
+    np.testing.assert_allclose(
+        np.asarray(packed.pull(jnp.clip(ids, 0, cap - 1))),
+        np.asarray(dense.pull(jnp.clip(ids, 0, cap - 1))),
+        rtol=1e-6,
+    )
+    a = dense.push(ids, deltas, mask)
+    b = packed.push(ids, deltas, mask)
+    np.testing.assert_allclose(
+        np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_packed_store_sharded_mesh(mesh):
+    rng = np.random.default_rng(4)
+    cap, d, n = 100, 17, 256
+    init = _rand_init(d)
+    dense = ShardedParamStore.create(cap, (d,), init_fn=init, mesh=mesh)
+    packed = ShardedParamStore.create(
+        cap, (d,), init_fn=init, mesh=mesh, layout="packed"
+    )
+    ids = jnp.asarray(rng.integers(0, cap, n).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(packed.pull(ids)), np.asarray(dense.pull(ids)), rtol=1e-6
+    )
+    a = dense.push(ids, deltas)
+    b = packed.push(ids, deltas)
+    np.testing.assert_allclose(
+        np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-5
+    )
+    # the packed table stays ps-sharded after a push
+    assert b.table.sharding.spec == jax.sharding.PartitionSpec("ps", None)
+
+
+def test_packed_int_counts_exact():
+    cap, d, n = 24, 4, 64
+    dense = ShardedParamStore.create(cap, (d,), dtype=jnp.int32)
+    packed = ShardedParamStore.create(
+        cap, (d,), dtype=jnp.int32, layout="packed"
+    )
+    ids = jnp.asarray(np.arange(n) % cap, jnp.int32)
+    deltas = jnp.ones((n, d), jnp.int32)
+    a = dense.push(ids, deltas)
+    b = packed.push(ids, deltas)
+    np.testing.assert_array_equal(np.asarray(a.values()), np.asarray(b.values()))
+
+
+def test_auto_layout_resolution():
+    s = ShardedParamStore.create(10, (17,), layout="auto")
+    assert s.spec.layout == "packed"
+    s = ShardedParamStore.create(10, (256,), layout="auto")
+    assert s.spec.layout == "dense"
+    with pytest.raises(ValueError, match="packed"):
+        ShardedParamStore.create(
+            10, (17,), update=lambda c, d: c + 2 * d, layout="packed"
+        )
+
+
+def test_packed_checkpoint_roundtrip(tmp_path):
+    from flink_parameter_server_tpu.training import checkpoint as ckpt
+
+    rng = np.random.default_rng(5)
+    cap, d = 30, 17
+    store = ShardedParamStore.create(
+        cap, (d,), init_fn=_rand_init(d), layout="packed"
+    )
+    store = store.push(
+        jnp.asarray([1, 5, 29], jnp.int32),
+        jnp.asarray(rng.normal(0, 1, (3, d)).astype(np.float32)),
+    )
+    path = str(tmp_path / "ck")
+    ckpt.save(path, store, worker_state=None, step=3)
+    restored, _, meta = ckpt.restore(path, store.spec)
+    assert restored.spec.layout == "packed"
+    np.testing.assert_allclose(
+        np.asarray(restored.values()), np.asarray(store.values()), rtol=1e-6
+    )
