@@ -107,10 +107,21 @@ def tpu_updates_per_sec(
             ) from None
         if dim <= 0:
             raise SystemExit(f"FPS_BENCH_DIM={dim}: must be positive")
-    if fused_requested and jax.default_backend() == "tpu" and dim % 128:
+    _bench_layout = os.environ.get("FPS_BENCH_LAYOUT", "dense")
+    _resolves_packed = _bench_layout == "packed" or (
+        _bench_layout == "auto" and dim < 128
+    )
+    if (
+        fused_requested
+        and jax.default_backend() == "tpu"
+        and dim % 128
+        and not _resolves_packed
+    ):
         raise SystemExit(
             f"FPS_BENCH_FUSED=1 needs dim % 128 == 0 on TPU (Mosaic lane "
-            f"alignment); got dim={dim}. Set FPS_BENCH_DIM=128."
+            f"alignment); got dim={dim}. Set FPS_BENCH_DIM=128 or "
+            f"FPS_BENCH_LAYOUT=packed (the lane-packed kernel runs any "
+            f"width)."
         )
 
     # Multi-chip TPU: shard over a dp × ps mesh and report PER-CHIP rate.
@@ -200,7 +211,11 @@ def tpu_updates_per_sec(
                 f"FPS_BENCH_FUSED_CHUNK={chunk}: must be positive"
             )
         step = jax.jit(
-            make_fused_mf_train_step(learning_rate=0.01, chunk=chunk),
+            make_fused_mf_train_step(
+                learning_rate=0.01, chunk=chunk,
+                layout=store.spec.layout,
+                capacity=num_items, dim=dim,
+            ),
             donate_argnums=(0, 1),
         )
     else:

@@ -86,6 +86,9 @@ def main():
         ("fused_d128", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "128",
                         "FPS_BENCH_SCATTER": "xla",
                         "FPS_BENCH_LAYOUT": "dense"}),
+        ("fused_packed_d64", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "64",
+                              "FPS_BENCH_SCATTER": "xla",
+                              "FPS_BENCH_LAYOUT": "packed"}),
     )
     for batch in (16_384, 65_536, 262_144):
         for tag, extra_env in variants:

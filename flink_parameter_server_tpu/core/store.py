@@ -284,14 +284,14 @@ def push(
             # Physical-row granularity: lane-shift each delta to its
             # sub-row offset, scatter at phys ids.  Masked lanes carry
             # zero deltas already (zeroed above) — no mask needed.
-            from ..ops.packed import lane_shift_deltas
+            from ..ops.packed import lane_shift_deltas, packed_phys_ids
 
             scatter_deltas = lane_shift_deltas(
                 flat_deltas.reshape(-1, spec.row_width).astype(table.dtype),
                 flat_ids,
                 spec.row_width,
             )
-            scatter_ids = flat_ids // spec.pack
+            scatter_ids = packed_phys_ids(flat_ids, spec.row_width)
             scatter_mask = None
         if spec.scatter_impl == "pallas":
             from ..ops import pallas_scatter as _pallas

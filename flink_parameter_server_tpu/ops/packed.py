@@ -111,6 +111,19 @@ def lane_shift_deltas(deltas: Array, ids: Array, row_width: int) -> Array:
     return jnp.where(valid, out, jnp.zeros_like(out))
 
 
+def lane_unshift(rows: Array, ids: Array, row_width: int) -> Array:
+    """Inverse of :func:`lane_shift_deltas`: slice each (phys_width,)
+    row back down to the (row_width,) slice at its id's lane offset."""
+    k = pack_k(row_width)
+    if k == 1:
+        return rows[:, :row_width]
+    cols = (
+        (ids.astype(jnp.int32) % k)[:, None] * row_width
+        + jnp.arange(row_width)[None, :]
+    )
+    return jnp.take_along_axis(rows, cols, axis=1)
+
+
 def packed_phys_ids(ids: Array, row_width: int) -> Array:
     """Logical ids -> physical row ids (sorting by these keeps id order)."""
     return ids.astype(jnp.int32) // pack_k(row_width)
@@ -125,5 +138,6 @@ __all__ = [
     "unpack_table",
     "packed_pull",
     "lane_shift_deltas",
+    "lane_unshift",
     "packed_phys_ids",
 ]

@@ -65,14 +65,22 @@ from .pallas_scatter import WINDOW, supports_shape  # noqa: E402
 def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             out_table_ref, udelta_ref, pred_ref,
             win_ref, acc_ref, carry_ref, sem_in, sem_out,
-            *, chunk: int, lr: float, reg: float):
+            *, chunk: int, lr: float, reg: float,
+            sub_k: int = 1, sub_width: int = 0):
     """One grid step = one chunk of lanes sorted by item id (chunk % 8 == 0).
 
-    ids_ref: (N,) int32 SMEM (scalar-prefetched) — sorted item ids.
-    p_ref: (chunk, d) VMEM — pre-gathered user rows (f32).
+    ids_ref: (N,) int32 SMEM (scalar-prefetched) — sorted LOGICAL item
+      ids.  With the packed layout (``sub_k`` > 1, ops/packed.py), item
+      ``i`` lives in physical row ``i // sub_k`` at lane offset
+      ``(i % sub_k) * sub_width``; the kernel windows over PHYSICAL rows
+      and masks per-lane math to the item's lane slice.  ``sub_k == 1``
+      is the dense layout (slice == the whole row).
+    p_ref: (chunk, d) VMEM — pre-gathered user rows (f32; lane-SHIFTED
+      to the item's slice when packed).
     r_ref / m_ref: (chunk, 1) VMEM — ratings / mask (f32).
-    table_ref/out_table_ref: aliased (capacity, d) HBM item table.
-    udelta_ref: (chunk, d) VMEM out — per-lane user deltas (f32).
+    table_ref/out_table_ref: aliased (phys_capacity, d) HBM item table.
+    udelta_ref: (chunk, d) VMEM out — per-lane user deltas (f32;
+      lane-shifted when packed — caller unshifts).
     pred_ref: (chunk, 1) VMEM out — per-lane predictions (f32).
     win_ref: (8, d) VMEM — the current window's PULLED snapshot (table
       dtype; all lanes of a window compute against it).
@@ -112,6 +120,10 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
         dma.wait()
 
     slot_iota = jax.lax.broadcasted_iota(jnp.int32, (WINDOW, 1), 0)
+    if sub_k > 1:
+        lane128 = jax.lax.broadcasted_iota(
+            jnp.int32, (1, win_ref.shape[1]), 1
+        )
 
     def switch_window(w):
         @pl.when(w != carry_ref[0])
@@ -123,19 +135,29 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             acc_ref[:] = jnp.zeros_like(acc_ref)
             carry_ref[0] = w
 
-    def lane_math(W, P, j, s_j, r_j, m_j):
+    def lane_math(W, P, j, id_j, r_j, m_j):
         """SGD math for one lane against window snapshot W.
 
-        Returns (pred_row, udelta_row, item_delta_row) as (1, d)/(1, 1)
-        values; the item delta is also accumulated into acc at slot s_j.
+        Returns (pred_row, udelta_row) as (1, 1)/(1, d) values; the item
+        delta is accumulated into acc at the lane's physical slot (and,
+        when packed, only within its lane slice — the other sub-rows of
+        the slot belong to other items).
         """
-        sel = (slot_iota == s_j).astype(jnp.float32)  # (8, 1) one-hot
-        q = jnp.sum(sel * W, axis=0, keepdims=True)   # (1, d) win[s_j]
+        phys = id_j // sub_k
+        sel = (slot_iota == phys % WINDOW).astype(jnp.float32)  # (8, 1)
+        q = jnp.sum(sel * W, axis=0, keepdims=True)   # (1, d) win[slot]
         p = P[j:j + 1, :]                             # static value slice
+        # packed: p is lane-shifted to the item's slice (zero elsewhere),
+        # so the dot never sees other sub-rows' lanes
         pred = jnp.sum(p * q, axis=1, keepdims=True)  # (1, 1)
         e = (m_j * lr) * (r_j - pred)                 # (1, 1)
         ud = e * q - (m_j * lr * reg) * p             # (1, d)
         idlt = e * p - (m_j * lr * reg) * q           # (1, d)
+        if sub_k > 1:
+            # e*q / reg*q leak outside the item's slice — mask them off
+            sl = (lane128 // sub_width == id_j % sub_k).astype(jnp.float32)
+            ud = sl * ud
+            idlt = sl * idlt
         acc_ref[:] = acc_ref[:] + sel * idlt
         return pred, ud
 
@@ -144,8 +166,8 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
         P = p_ref[pl.ds(g * 8, 8), :]
         r_col = r_ref[pl.ds(g * 8, 8), :]
         m_col = m_ref[pl.ds(g * 8, 8), :]
-        w_first = ids_ref[gbase] // WINDOW
-        w_last = ids_ref[gbase + 7] // WINDOW
+        w_first = (ids_ref[gbase] // sub_k) // WINDOW
+        w_last = (ids_ref[gbase + 7] // sub_k) // WINDOW
         lane_iota = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0)
 
         @pl.when(w_first == w_last)
@@ -159,7 +181,7 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             for j in range(8):
                 lane_sel = (lane_iota == j).astype(jnp.float32)
                 pred, ud = lane_math(
-                    W, P, j, ids_ref[gbase + j] % WINDOW,
+                    W, P, j, ids_ref[gbase + j],
                     r_col[j:j + 1, :], m_col[j:j + 1, :],
                 )
                 UD = UD + lane_sel * ud
@@ -175,10 +197,10 @@ def _kernel(ids_ref, p_ref, r_ref, m_ref, table_ref,
             PRED = jnp.zeros((8, 1), jnp.float32)
             for j in range(8):
                 id_j = ids_ref[gbase + j]
-                switch_window(id_j // WINDOW)
+                switch_window((id_j // sub_k) // WINDOW)
                 lane_sel = (lane_iota == j).astype(jnp.float32)
                 pred, ud = lane_math(
-                    win_ref[:].astype(jnp.float32), P, j, id_j % WINDOW,
+                    win_ref[:].astype(jnp.float32), P, j, id_j,
                     r_col[j:j + 1, :], m_col[j:j + 1, :],
                 )
                 UD = UD + lane_sel * ud
@@ -208,6 +230,8 @@ def _sorted_fused_call(
     regularization: float,
     chunk: int,
     interpret: bool,
+    sub_k: int = 1,
+    sub_width: int = 0,
 ) -> Tuple[Array, Array, Array]:
     """Kernel invocation on pre-sorted, chunk-padded lanes.
 
@@ -246,6 +270,7 @@ def _sorted_fused_call(
     kernel = functools.partial(
         _kernel, chunk=chunk,
         lr=float(learning_rate), reg=float(regularization),
+        sub_k=sub_k, sub_width=sub_width,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -395,6 +420,72 @@ def fused_mf_sgd(
     return new_user_table, new_item_table, pred
 
 
+def fused_mf_sgd_packed(
+    user_table: Array,
+    packed_item_table: Array,
+    users: Array,
+    items: Array,
+    ratings: Array,
+    mask: Optional[Array] = None,
+    *,
+    capacity: int,
+    dim: int,
+    learning_rate: float = 0.01,
+    regularization: float = 0.0,
+    chunk: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[Array, Array, Array]:
+    """The fused step over a lane-PACKED item table (ops/packed.py) —
+    the reference's native narrow dims (MF 64, FM 17) on the compiled
+    kernel, which needs 128-wide rows on real Mosaic.
+
+    ``packed_item_table``: (phys_capacity, 128·m) as built by
+    ``ShardedParamStore(layout="packed")`` / ``ops.packed.pack_table``.
+    ``capacity``/``dim``: the LOGICAL item count and row width.
+
+    XLA side does the lane plumbing (both batch-sized gathers): user
+    rows are pre-shifted to their item's lane slice, and the kernel's
+    lane-shifted user deltas are unshifted before the user scatter.  The
+    kernel itself windows over physical rows and masks its math to the
+    item's slice — semantics identical to :func:`fused_mf_sgd` on the
+    equivalent dense table (asserted by tests/test_pallas_mf.py).
+
+    Returns ``(new_user_table, new_packed_item_table, predictions)``.
+    """
+    from .packed import lane_shift_deltas, lane_unshift, pack_k
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    k = pack_k(dim)
+    if capacity > packed_item_table.shape[0] * k:
+        # a mismatched capacity would route lanes past the physical
+        # table — interpret mode clamps the window DMA and silently
+        # corrupts, so fail loudly here (the dense path can't hit this:
+        # it derives capacity from the table shape)
+        raise ValueError(
+            f"capacity {capacity} exceeds the packed table's "
+            f"{packed_item_table.shape[0]} physical rows x k={k} = "
+            f"{packed_item_table.shape[0] * k} logical rows"
+        )
+    n = items.shape[0]
+    order, s_items, s_users, s_r, s_m, s_p = _sort_pad_lanes(
+        capacity, user_table, users, items, ratings, mask, chunk
+    )
+    s_p_shifted = lane_shift_deltas(s_p, s_items, dim)
+    new_packed, udeltas, preds = _sorted_fused_call(
+        packed_item_table, s_items, s_p_shifted, s_r, s_m,
+        learning_rate=learning_rate, regularization=regularization,
+        chunk=chunk, interpret=interpret, sub_k=k, sub_width=dim,
+    )
+    # unshift the lane-shifted user deltas back to logical width
+    ud = lane_unshift(udeltas, s_items, dim)
+    new_user_table = user_table.at[s_users].add(
+        ud.astype(user_table.dtype), mode="drop"
+    )
+    pred = jnp.zeros((n,), jnp.float32).at[order[:n]].set(preds[:n, 0])
+    return new_user_table, new_packed, pred
+
+
 def fused_mf_sgd_sharded(
     user_table: Array,
     item_table: Array,
@@ -522,14 +613,30 @@ def make_fused_mf_train_step(
     regularization: float = 0.0,
     chunk: int = 1024,
     interpret: Optional[bool] = None,
+    layout: str = "dense",
+    capacity: Optional[int] = None,
+    dim: Optional[int] = None,
 ):
     """A drop-in alternative to ``make_train_step(OnlineMatrixFactorization,
     spec)`` for the MF flagship: same ``(table, state, batch) -> (table,
-    state, out)`` signature (state = user factor table), fused item side."""
+    state, out)`` signature (state = user factor table), fused item side.
+
+    ``layout="packed"`` (with the LOGICAL ``capacity`` and ``dim``) runs
+    the fused kernel on a lane-packed item table — pass the table from a
+    ``ShardedParamStore(layout="packed")``."""
+    if layout == "packed" and (capacity is None or dim is None):
+        raise ValueError("layout='packed' needs capacity= and dim=")
+
+    if layout == "packed":
+        fused_fn = fused_mf_sgd_packed
+        layout_kwargs = {"capacity": capacity, "dim": dim}
+    else:
+        fused_fn = fused_mf_sgd
+        layout_kwargs = {}
 
     def step(item_table, user_table, batch):
         mask = batch.get("mask")
-        new_users, new_items, pred = fused_mf_sgd(
+        new_users, new_items, pred = fused_fn(
             user_table,
             item_table,
             batch["user"],
@@ -540,6 +647,7 @@ def make_fused_mf_train_step(
             regularization=regularization,
             chunk=chunk,
             interpret=interpret,
+            **layout_kwargs,
         )
         m = (
             jnp.ones_like(pred)
@@ -557,6 +665,7 @@ def make_fused_mf_train_step(
 
 __all__ = [
     "fused_mf_sgd",
+    "fused_mf_sgd_packed",
     "fused_mf_sgd_sharded",
     "make_fused_mf_train_step",
     "supports_shape",
