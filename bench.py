@@ -107,9 +107,13 @@ def tpu_updates_per_sec(
             ) from None
         if dim <= 0:
             raise SystemExit(f"FPS_BENCH_DIM={dim}: must be positive")
-    _bench_layout = os.environ.get("FPS_BENCH_LAYOUT", "dense")
-    _resolves_packed = _bench_layout == "packed" or (
-        _bench_layout == "auto" and dim < 128
+    from flink_parameter_server_tpu.core.store import _resolve_layout
+
+    _resolves_packed = (
+        _resolve_layout(
+            os.environ.get("FPS_BENCH_LAYOUT", "dense"), "add", (dim,)
+        )
+        == "packed"
     )
     if (
         fused_requested

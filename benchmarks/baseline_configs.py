@@ -42,6 +42,22 @@ def _is_tpu() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _store_opts() -> dict:
+    """Store construction knobs for the sparse-PS configs (2/3/4):
+    FPS_CFG_SCATTER=xla|pallas, FPS_CFG_LAYOUT=dense|packed|auto.
+    pallas is downgraded off-TPU (interpret mode is not a perf path)."""
+    scatter = os.environ.get("FPS_CFG_SCATTER", "xla")
+    layout = os.environ.get("FPS_CFG_LAYOUT", "dense")
+    if scatter == "pallas" and not _is_tpu():
+        print(
+            "# no TPU: FPS_CFG_SCATTER=pallas would run interpreted; "
+            "using xla",
+            file=sys.stderr,
+        )
+        scatter = "xla"
+    return {"scatter_impl": scatter, "layout": layout}
+
+
 def _row(config: str, value: float, unit: str, **extra) -> None:
     print(
         json.dumps(
@@ -87,7 +103,8 @@ def bench_pa():
     K = 32  # active features per example
     F = 2_000_000 if tpu else 100_000  # feature space
 
-    store = ShardedParamStore.create(F, ())
+    opts = _store_opts()
+    store = ShardedParamStore.create(F, (), **opts)
     logic = PassiveAggressiveBinary()
     rng = np.random.default_rng(0)
     batch = {
@@ -105,6 +122,7 @@ def bench_pa():
         "2-passive-aggressive-binary", B / dt, "examples/sec",
         batch=B, active_features=K, feature_space=F,
         lane_updates_per_sec=round(B * K / dt, 1),
+        **opts,
     )
 
 
@@ -124,7 +142,8 @@ def bench_w2v():
     V = 1_000_000 if tpu else 50_000
     dim = 128 if tpu else 64
 
-    store = word2vec.make_store(V, dim)
+    opts = _store_opts()
+    store = word2vec.make_store(V, dim, **opts)
     logic = word2vec.SkipGramNS(0.025)
     rng = np.random.default_rng(0)
     batch = {
@@ -139,7 +158,7 @@ def bench_w2v():
     dt = _time_steps(step, (store.table, ()), batch)
     _row(
         "3-word2vec-sgns", B / dt, "pairs/sec",
-        batch=B, negatives=N, vocab=V, dim=dim,
+        batch=B, negatives=N, vocab=V, dim=dim, **opts,
     )
 
 
@@ -163,7 +182,8 @@ def bench_fm(stress: bool = False):
     dim = 16
 
     cfg = fm.FMConfig(num_features=F, dim=dim, learning_rate=0.01)
-    store = fm.make_store(cfg)
+    opts = _store_opts()
+    store = fm.make_store(cfg, **opts)
     logic = fm.FactorizationMachine(cfg)
     rng = np.random.default_rng(0)
     batch = {
@@ -181,7 +201,7 @@ def bench_fm(stress: bool = False):
     _row(
         "4-factorization-machine", B / dt, "examples/sec",
         batch=B, features_per_example=K, table_rows=F,
-        table_gib=round(table_gb, 2), dim=dim,
+        table_gib=round(table_gb, 2), dim=dim, **opts,
     )
 
 
@@ -284,16 +304,15 @@ BENCHES = {
 
 
 def main():
-    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    which = sys.argv[1:] or ["all"]
+    bad = [w for w in which if w != "all" and w not in BENCHES]
+    if bad:
+        raise SystemExit(f"unknown config(s) {bad}; use {list(BENCHES)}")
     platform = _ensure_backend_alive()
     print(f"# platform: {platform}", file=sys.stderr)
-    if which == "all":
-        for name, fn in BENCHES.items():
-            fn()
-    elif which in BENCHES:
-        BENCHES[which]()
-    else:
-        raise SystemExit(f"unknown config {which!r}; use {list(BENCHES)}")
+    names = list(BENCHES) if "all" in which else which
+    for name in names:
+        BENCHES[name]()
 
 
 if __name__ == "__main__":

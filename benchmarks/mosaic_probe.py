@@ -197,6 +197,36 @@ def ids_col_slice(group, mult):
     )(x)
 
 
+def lane_roll_pad(dtype, d_sub, k):
+    """In-register pad (8,d)->(8,128) + k static lane rolls + masked
+    select — the packed kernels' in-kernel shift pattern."""
+    def kernel(ids_ref, x_ref, o_ref):
+        G = x_ref[:].astype(jnp.float32)
+        G_pad = jnp.pad(G, ((0, 0), (0, 128 - d_sub)))
+        lane8 = jax.lax.broadcasted_iota(jnp.int32, (8, 1), 0)
+        t_col = jnp.zeros((8, 1), jnp.int32)
+        for j in range(8):
+            t_col = t_col + jnp.where(lane8 == j, ids_ref[j] % k, 0)
+        out = jnp.zeros_like(G_pad)
+        for tt in range(k):
+            sel = (t_col == tt).astype(jnp.float32)
+            out = out + sel * jnp.roll(G_pad, tt * d_sub, axis=1)
+        o_ref[:] = out
+
+    x = jnp.ones((8, d_sub), dtype)
+    ids = jnp.arange(8, dtype=jnp.int32)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(1,),
+        in_specs=[pl.BlockSpec((8, d_sub), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((8, 128), lambda c, ids: (0, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        grid_spec=spec)(ids, x)
+
+
 def main():
     assert jax.default_backend() == "tpu", "probe needs a live TPU"
     results = {}
@@ -232,6 +262,18 @@ def main():
     results["ids_col_slice16"] = check(
         "int32 (16,1) column slice at 16*g",
         functools.partial(ids_col_slice, 16, 16))
+    # packed-kernel patterns: narrow full-extent minor slices and the
+    # in-register pad + static-lane-roll shift
+    for dt, dname in [(jnp.float32, "f32"), (jnp.bfloat16, "bf16")]:
+        results[f"vmem_slice8_{dname}_d17"] = check(
+            f"vmem read (8,17) slice at 8*g {dname} (narrow full-extent)",
+            functools.partial(vmem_slice, dt, 17, 8, 8))
+        results[f"lane_roll_pad_{dname}_d17k7"] = check(
+            f"pad+static-roll shift {dname} d=17 k=7",
+            functools.partial(lane_roll_pad, dt, 17, 7))
+        results[f"lane_roll_pad_{dname}_d64k2"] = check(
+            f"pad+static-roll shift {dname} d=64 k=2",
+            functools.partial(lane_roll_pad, dt, 64, 2))
     n_pass = sum(results.values())
     print(f"\n{n_pass}/{len(results)} patterns pass")
 

@@ -133,13 +133,27 @@ def main():
         )
     )
 
-    # 4. all five baseline configs
+    # 4. all five baseline configs — default (xla/dense) arm, then the
+    # sparse configs again on pallas+packed (the A/B the scatter/layout
+    # defaults hang on; every knob pinned per arm)
+    env_a = dict(os.environ)
+    env_a.update({"FPS_CFG_SCATTER": "xla", "FPS_CFG_LAYOUT": "dense"})
     results.append(
         run_job(
             "baseline_configs",
             [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
              "all"],
-            int(1200 * scale), OUT_DIR,
+            int(1200 * scale), OUT_DIR, env=env_a,
+        )
+    )
+    env_b = dict(os.environ)
+    env_b.update({"FPS_CFG_SCATTER": "pallas", "FPS_CFG_LAYOUT": "packed"})
+    results.append(
+        run_job(
+            "baseline_configs_packed_pallas",
+            [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
+             "pa", "w2v", "fm"],
+            int(900 * scale), OUT_DIR, env=env_b,
         )
     )
 

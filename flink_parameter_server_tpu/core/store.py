@@ -227,6 +227,27 @@ def pull(spec: StoreSpec, table: Array, ids: Array) -> Array:
     return jnp.take(table, ids, axis=0)
 
 
+def _phys_scatter_args(
+    spec: StoreSpec, table: Array, flat_ids: Array, flat_deltas: Array
+):
+    """(ids, deltas) at PHYSICAL granularity for XLA/sharded scatters.
+
+    Dense: passthrough.  Packed: lane-shift each delta row to its
+    sub-row offset and divide ids down to physical rows (the sentinel
+    ``padded_capacity`` divides to the out-of-range physical row, so
+    ``mode="drop"`` semantics are preserved)."""
+    if spec.layout != "packed":
+        return flat_ids, flat_deltas
+    from ..ops.packed import lane_shift_deltas, packed_phys_ids
+
+    shifted = lane_shift_deltas(
+        flat_deltas.reshape(-1, spec.row_width).astype(table.dtype),
+        flat_ids,
+        spec.row_width,
+    )
+    return packed_phys_ids(flat_ids, spec.row_width), shifted
+
+
 def push(
     spec: StoreSpec,
     table: Array,
@@ -275,24 +296,6 @@ def push(
         )
 
     if spec.update == "add":
-        scatter_ids, scatter_deltas, scatter_mask = (
-            flat_ids,
-            flat_deltas,
-            None if mask is None else flat_mask,
-        )
-        if spec.layout == "packed":
-            # Physical-row granularity: lane-shift each delta to its
-            # sub-row offset, scatter at phys ids.  Masked lanes carry
-            # zero deltas already (zeroed above) — no mask needed.
-            from ..ops.packed import lane_shift_deltas, packed_phys_ids
-
-            scatter_deltas = lane_shift_deltas(
-                flat_deltas.reshape(-1, spec.row_width).astype(table.dtype),
-                flat_ids,
-                spec.row_width,
-            )
-            scatter_ids = packed_phys_ids(flat_ids, spec.row_width)
-            scatter_mask = None
         if spec.scatter_impl == "pallas":
             from ..ops import pallas_scatter as _pallas
 
@@ -301,21 +304,47 @@ def push(
             # benchmarks/mosaic_probe.py).  Interpreter mode (non-TPU)
             # has no dim constraint; capacity is window-aligned by
             # rows_per_shard either way.  The packed layout is always
-            # eligible (width 128 by construction).
-            scatter_width = int(
-                np.prod(scatter_deltas.shape[1:])
-            ) if scatter_deltas.ndim > 1 else 1
+            # eligible (physical width 128 by construction).
+            kernel_width = (
+                int(np.prod(table.shape[1:]))
+                if spec.layout == "packed"
+                else spec.row_width
+            )
             shapes_ok = jax.default_backend() != "tpu" or _pallas.supports_shape(
-                spec.rows_per_shard, scatter_width
+                spec.rows_per_shard, kernel_width
             )
             if not shapes_ok:
                 _note_pallas_fallback(
-                    f"table row width {scatter_width} not a multiple of 128 "
+                    f"table row width {kernel_width} not a multiple of 128 "
                     f"(Mosaic lane alignment; use layout='packed')"
                 )
             elif spec.num_shards == 1:
+                if (
+                    spec.layout == "packed"
+                    and spec.pack <= _pallas.MAX_INKERNEL_SUB_K
+                ):
+                    # logical ids + logical-width deltas: the kernel
+                    # lane-shifts in-register, so the HBM delta buffer
+                    # never pays the 128-lane expansion
+                    return _pallas.scatter_add(
+                        table,
+                        flat_ids,
+                        flat_deltas.reshape(-1, spec.row_width),
+                        None,
+                        sub_k=spec.pack,
+                        sub_width=spec.row_width,
+                    )
+                if spec.layout == "packed":
+                    # very narrow rows (e.g. scalars, pack=128): sub_k
+                    # unrolled in-kernel rolls would dominate — pre-shift
+                    # XLA-side and scatter at physical granularity
+                    s_ids, s_deltas = _phys_scatter_args(
+                        spec, table, flat_ids, flat_deltas
+                    )
+                    return _pallas.scatter_add(table, s_ids, s_deltas, None)
                 return _pallas.scatter_add(
-                    table, scatter_ids, scatter_deltas, scatter_mask,
+                    table, flat_ids, flat_deltas,
+                    None if mask is None else flat_mask,
                 )
             else:
                 # Sharded: run the kernel per ps shard under shard_map
@@ -325,20 +354,23 @@ def push(
                 from ..parallel.collectives import shard_push_add
                 from ..parallel.mesh import DP_AXIS
 
+                s_ids, s_deltas = _phys_scatter_args(
+                    spec, table, flat_ids, flat_deltas
+                )
                 mesh = spec.mesh
                 dp_axis = (
                     DP_AXIS
                     if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
                     else None
                 )
-                n = scatter_ids.shape[0]
+                n = s_ids.shape[0]
                 if dp_axis is None or n % mesh.shape[dp_axis] == 0:
                     # mask=None: masked lanes' deltas were zeroed above,
                     # so a no-op under add — skip the extra mask all_gather
                     return shard_push_add(
                         table,
-                        scatter_ids,
-                        scatter_deltas,
+                        s_ids,
+                        s_deltas,
                         None,
                         mesh=mesh,
                         ps_axis=spec.ps_axis,
@@ -348,8 +380,11 @@ def push(
                 _note_pallas_fallback(
                     f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
                 )
-        return table.at[scatter_ids].add(
-            scatter_deltas.astype(table.dtype), mode="drop"
+        s_ids, s_deltas = _phys_scatter_args(
+            spec, table, flat_ids, flat_deltas
+        )
+        return table.at[s_ids].add(
+            s_deltas.astype(table.dtype), mode="drop"
         )
 
     # Generic path: combine duplicates densely, then apply `update` once per

@@ -120,6 +120,8 @@ def make_store(
     seed: int = 0,
     mesh=None,
     init_scale: float = 0.5,
+    scatter_impl: str = "xla",
+    layout: str = "dense",
 ) -> ShardedParamStore:
     """(vocab, 2, dim) store; input slot random-uniform (the word2vec
     convention: U(-0.5/dim, 0.5/dim)), output slot zero."""
@@ -132,7 +134,8 @@ def make_store(
         return jnp.stack([in_emb, jnp.zeros_like(in_emb)], axis=1)
 
     return ShardedParamStore.create(
-        vocab_size, (2, dim), init_fn=init, mesh=mesh
+        vocab_size, (2, dim), init_fn=init, mesh=mesh,
+        scatter_impl=scatter_impl, layout=layout,
     )
 
 

@@ -457,6 +457,20 @@ def fused_mf_sgd_packed(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     k = pack_k(dim)
+    nphys = packed_item_table.shape[0]
+    nphys8 = ((nphys + WINDOW - 1) // WINDOW) * WINDOW
+    if nphys8 != nphys:
+        # window-align with a pad copy, like fused_mf_sgd does for dense
+        # tables (pack_table's default phys row count is NOT 8-aligned;
+        # stores align at create time)
+        padded = jnp.pad(packed_item_table, ((0, nphys8 - nphys), (0, 0)))
+        new_users, new_packed, pred = fused_mf_sgd_packed(
+            user_table, padded, users, items, ratings, mask,
+            capacity=capacity, dim=dim,
+            learning_rate=learning_rate, regularization=regularization,
+            chunk=chunk, interpret=interpret,
+        )
+        return new_users, new_packed[:nphys], pred
     if capacity > packed_item_table.shape[0] * k:
         # a mismatched capacity would route lanes past the physical
         # table — interpret mode clamps the window DMA and silently
@@ -624,6 +638,15 @@ def make_fused_mf_train_step(
     ``layout="packed"`` (with the LOGICAL ``capacity`` and ``dim``) runs
     the fused kernel on a lane-packed item table — pass the table from a
     ``ShardedParamStore(layout="packed")``."""
+    if layout not in ("dense", "packed"):
+        # 'auto' is a STORE-construction convenience; here the layout
+        # must match the concrete table being passed — silently treating
+        # an unknown value as dense would read a packed table as dense
+        # rows and train garbage
+        raise ValueError(
+            f"layout must be 'dense' or 'packed' (matching the item "
+            f"table's actual layout), got {layout!r}"
+        )
     if layout == "packed" and (capacity is None or dim is None):
         raise ValueError("layout='packed' needs capacity= and dim=")
 

@@ -68,7 +68,8 @@ def test_lane_shift_scatter_equivalence():
 
 
 @pytest.mark.parametrize("d,impl", [(17, "xla"), (17, "pallas"),
-                                    (64, "pallas"), (1, "xla")])
+                                    (64, "pallas"), (1, "xla"),
+                                    (1, "pallas")])
 def test_packed_store_matches_dense(d, impl):
     rng = np.random.default_rng(3)
     cap, n = 61, 400
@@ -159,4 +160,61 @@ def test_packed_checkpoint_roundtrip(tmp_path):
     assert restored.spec.layout == "packed"
     np.testing.assert_allclose(
         np.asarray(restored.values()), np.asarray(store.values()), rtol=1e-6
+    )
+
+
+def test_scatter_add_inkernel_shift_matches_expansion():
+    """scatter_add(sub_k=...) (in-kernel lane shift, logical-width
+    deltas) == phys-granularity scatter of XLA-expanded deltas."""
+    from flink_parameter_server_tpu.ops.pallas_scatter import scatter_add
+
+    rng = np.random.default_rng(7)
+    for d in (17, 64):
+        k = pack_k(d)
+        cap = 96
+        v = jnp.asarray(rng.normal(0, 1, (cap, d)).astype(np.float32))
+        nphys = ((cap + k - 1) // k + 7) // 8 * 8
+        packed = pack_table(v, nphys)
+        n = 500
+        ids = jnp.asarray(rng.integers(-3, cap + 3, n).astype(np.int32))
+        deltas = jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32))
+        out = scatter_add(
+            packed, ids, deltas, chunk=64, interpret=True,
+            sub_k=k, sub_width=d,
+        )
+        ref_logical = v.at[jnp.clip(ids, 0, cap - 1)].add(
+            jnp.where(((ids < 0) | (ids >= cap))[:, None], 0.0, deltas)
+        )
+        got = unpack_table(out, cap, d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref_logical), rtol=1e-4, atol=1e-5
+        )
+    # very narrow rows (sub_k > MAX_INKERNEL_SUB_K) must refuse the
+    # in-kernel shift with a remedy (the store pre-shifts instead)
+    with pytest.raises(ValueError, match="pre-shift"):
+        scatter_add(
+            jnp.zeros((8, 128), jnp.float32),
+            jnp.zeros((4,), jnp.int32),
+            jnp.zeros((4, 4), jnp.float32),
+            chunk=8, interpret=True, sub_k=32, sub_width=4,
+        )
+
+
+def test_store_packed_pallas_single_shard_logical_path():
+    """The packed store's single-shard pallas push (in-kernel shift)
+    matches the dense store bit-for-bit within tolerance."""
+    rng = np.random.default_rng(8)
+    cap, d, n = 70, 17, 300
+    init = _rand_init(d)
+    dense = ShardedParamStore.create(cap, (d,), init_fn=init)
+    packed = ShardedParamStore.create(
+        cap, (d,), init_fn=init, scatter_impl="pallas", layout="packed"
+    )
+    ids = jnp.asarray(rng.integers(-2, cap + 2, n).astype(np.int32))
+    deltas = jnp.asarray(rng.normal(0, 1, (n, d)).astype(np.float32))
+    mask = jnp.asarray(rng.random(n) > 0.25)
+    a = dense.push(ids, deltas, mask)
+    b = packed.push(ids, deltas, mask)
+    np.testing.assert_allclose(
+        np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-5
     )

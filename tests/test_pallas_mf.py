@@ -228,7 +228,8 @@ class TestPackedFused:
     """fused_mf_sgd_packed == fused_mf_sgd on the equivalent dense table
     (lane-packed layout, ops/packed.py)."""
 
-    def _run_pair(self, num_users, num_items, dim, batch, chunk=16, seed=0):
+    def _run_pair(self, num_users, num_items, dim, batch, chunk=16, seed=0,
+                  zipf=False):
         from flink_parameter_server_tpu.ops.packed import (
             pack_table, phys_rows, unpack_table,
         )
@@ -245,6 +246,8 @@ class TestPackedFused:
             "user": jnp.asarray(
                 rng.integers(0, num_users, batch).astype(np.int32)),
             "item": jnp.asarray(
+                ((rng.zipf(1.2, batch) - 1) % num_items).astype(np.int32)
+                if zipf else
                 rng.integers(-2, num_items + 2, batch).astype(np.int32)),
             "rating": jnp.asarray(
                 rng.normal(0, 1, batch).astype(np.float32)),
@@ -280,13 +283,9 @@ class TestPackedFused:
         self._run_pair(12, 60, 17, 64)
 
     def test_k2_mf_dim64_zipf_hot(self):
-        from flink_parameter_server_tpu.ops.packed import (
-            pack_table, phys_rows,
-        )
-        rng = np.random.default_rng(3)
-        # replace uniform items with a Zipf-hot stream (long same-id runs)
-        num_users, num_items, dim, batch = 16, 40, 64, 96
-        self._run_pair(num_users, num_items, dim, batch, seed=3)
+        # Zipf-hot item stream: long same-id runs exercise the
+        # single-window fast path and cross-sub-row accumulation
+        self._run_pair(16, 40, 64, 96, seed=3, zipf=True)
 
     def test_train_step_factory_packed(self):
         from flink_parameter_server_tpu.core.store import ShardedParamStore
