@@ -107,14 +107,20 @@ def tpu_updates_per_sec(
             ) from None
         if dim <= 0:
             raise SystemExit(f"FPS_BENCH_DIM={dim}: must be positive")
+    # FPS_BENCH_SCATTER=pallas + FPS_BENCH_LAYOUT=packed: the sorted-
+    # window kernel on a lane-packed table (the TPU-native path for the
+    # reference's narrow dim-64 rows; ops/packed.py).  Validate both
+    # knobs BEFORE any use — an invalid value must exit with the clean
+    # one-liner, not a _resolve_layout traceback.
+    scatter_impl = os.environ.get("FPS_BENCH_SCATTER", "xla")
+    layout = os.environ.get("FPS_BENCH_LAYOUT", "dense")
+    if scatter_impl not in ("xla", "pallas"):
+        raise SystemExit(f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas")
+    if layout not in ("dense", "packed", "auto"):
+        raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
     from flink_parameter_server_tpu.core.store import _resolve_layout
 
-    _resolves_packed = (
-        _resolve_layout(
-            os.environ.get("FPS_BENCH_LAYOUT", "dense"), "add", (dim,)
-        )
-        == "packed"
-    )
+    _resolves_packed = _resolve_layout(layout, "add", (dim,)) == "packed"
     if (
         fused_requested
         and jax.default_backend() == "tpu"
@@ -150,15 +156,6 @@ def tpu_updates_per_sec(
     # (interpret mode on CPU is not a perf number — flag ignored there)
     fused = fused_requested and jax.default_backend() == "tpu"
 
-    # FPS_BENCH_SCATTER=pallas + FPS_BENCH_LAYOUT=packed: the sorted-
-    # window kernel on a lane-packed table (the TPU-native path for the
-    # reference's narrow dim-64 rows; ops/packed.py).
-    scatter_impl = os.environ.get("FPS_BENCH_SCATTER", "xla")
-    layout = os.environ.get("FPS_BENCH_LAYOUT", "dense")
-    if scatter_impl not in ("xla", "pallas"):
-        raise SystemExit(f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas")
-    if layout not in ("dense", "packed", "auto"):
-        raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
     if scatter_impl == "pallas" and jax.default_backend() != "tpu":
         # interpreter-mode pallas at bench batch sizes would wedge the
         # CPU-fallback run — the exact failure the fallback exists to

@@ -48,6 +48,12 @@ def _store_opts() -> dict:
     pallas is downgraded off-TPU (interpret mode is not a perf path)."""
     scatter = os.environ.get("FPS_CFG_SCATTER", "xla")
     layout = os.environ.get("FPS_CFG_LAYOUT", "dense")
+    if scatter not in ("xla", "pallas"):
+        # a typo would silently benchmark XLA while the JSON row records
+        # the typo as the pallas arm (bench.py has the same validation)
+        raise SystemExit(f"FPS_CFG_SCATTER={scatter!r}: xla|pallas")
+    if layout not in ("dense", "packed", "auto"):
+        raise SystemExit(f"FPS_CFG_LAYOUT={layout!r}: dense|packed|auto")
     if scatter == "pallas" and not _is_tpu():
         print(
             "# no TPU: FPS_CFG_SCATTER=pallas would run interpreted; "
@@ -56,6 +62,14 @@ def _store_opts() -> dict:
         )
         scatter = "xla"
     return {"scatter_impl": scatter, "layout": layout}
+
+
+def _resolved(store) -> dict:
+    """What actually ran (layout='auto' resolves at store creation)."""
+    return {
+        "scatter_impl": store.spec.scatter_impl,
+        "layout": store.spec.layout,
+    }
 
 
 def _row(config: str, value: float, unit: str, **extra) -> None:
@@ -122,7 +136,7 @@ def bench_pa():
         "2-passive-aggressive-binary", B / dt, "examples/sec",
         batch=B, active_features=K, feature_space=F,
         lane_updates_per_sec=round(B * K / dt, 1),
-        **opts,
+        **_resolved(store),
     )
 
 
@@ -158,7 +172,7 @@ def bench_w2v():
     dt = _time_steps(step, (store.table, ()), batch)
     _row(
         "3-word2vec-sgns", B / dt, "pairs/sec",
-        batch=B, negatives=N, vocab=V, dim=dim, **opts,
+        batch=B, negatives=N, vocab=V, dim=dim, **_resolved(store),
     )
 
 
@@ -201,7 +215,7 @@ def bench_fm(stress: bool = False):
     _row(
         "4-factorization-machine", B / dt, "examples/sec",
         batch=B, features_per_example=K, table_rows=F,
-        table_gib=round(table_gb, 2), dim=dim, **opts,
+        table_gib=round(table_gb, 2), dim=dim, **_resolved(store),
     )
 
 

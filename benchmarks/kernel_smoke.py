@@ -1,0 +1,174 @@
+"""Fast on-chip smoke of every compiled Pallas path (run before the full
+battery — a failed Mosaic lowering here saves a 20-minute tunnel window).
+
+Each case compares the compiled kernel against the XLA reference on small
+Zipf-hot shapes and prints PASS/FAIL with the max abs error.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import jax
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# This image's sitecustomize pins JAX_PLATFORMS to the remote-TPU plugin
+# whose backend init can block forever on a wedged tunnel — probe in a
+# subprocess first and drop to CPU (interpret mode) if the chip is gone
+# (same pattern as bench.py / __graft_entry__.py; conftest.py documents
+# why env edits are too late and jax.config.update is required).
+from flink_parameter_server_tpu.utils.backend_probe import probe_backend
+
+if "--cpu" in sys.argv or not probe_backend()[0]:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from flink_parameter_server_tpu.ops import packed as pk  # noqa: E402
+from flink_parameter_server_tpu.ops import pallas_mf, pallas_scatter  # noqa: E402
+
+
+def _zipf_ids(rng, n, cap):
+    ids = rng.zipf(1.3, size=n) % cap
+    return jnp.asarray(ids, jnp.int32)
+
+
+def check(name, got, want, tol):
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    ok = err <= tol
+    print(f"[{'PASS' if ok else 'FAIL'}] {name}: max_abs_err={err:.3e}")
+    return ok
+
+
+def main():
+    rng = np.random.default_rng(0)
+    ok = True
+    on_tpu = jax.default_backend() == "tpu"
+    print(f"backend={jax.default_backend()} devices={jax.devices()}",
+          flush=True)
+
+    # CPU = interpret mode (slow): shrink the batch — correctness at
+    # depth is the test suite's job; this script's job is real Mosaic.
+    n = 4096 if on_tpu else 512
+
+    # 1. dense scatter, d=128 (the always-eligible compiled shape)
+    cap, d = 1024, 128
+    table = jnp.asarray(rng.normal(size=(cap, d)), jnp.float32)
+    ids = _zipf_ids(rng, n, cap)
+    deltas = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    want = table.at[ids].add(deltas)
+    got = jax.jit(
+        lambda t, i, dl: pallas_scatter.scatter_add(
+            t, i, dl, interpret=not on_tpu)
+    )(table, ids, deltas)
+    ok &= check("scatter dense d128 f32", got, want, 1e-3)
+
+    # 2. dense scatter, bf16 table.  The kernel sums a window's deltas in
+    # f32 and rounds ONCE per RMW; XLA's scatter rounds per-add — so they
+    # legitimately differ on Zipf-hot rows.  Judge both against the f32
+    # oracle: the kernel must be at least as accurate as XLA.
+    table16 = table.astype(jnp.bfloat16)
+    xla16 = table16.at[ids].add(deltas.astype(jnp.bfloat16))
+    got16 = jax.jit(
+        lambda t, i, dl: pallas_scatter.scatter_add(
+            t, i, dl, interpret=not on_tpu)
+    )(table16, ids, deltas.astype(jnp.bfloat16))
+    oracle = table16.astype(jnp.float32).at[ids].add(deltas)
+    err_kernel = float(jnp.max(jnp.abs(got16.astype(jnp.float32) - oracle)))
+    err_xla = float(jnp.max(jnp.abs(xla16.astype(jnp.float32) - oracle)))
+    ok16 = err_kernel <= err_xla * 1.05 + 1e-3
+    print(f"[{'PASS' if ok16 else 'FAIL'}] scatter dense d128 bf16: "
+          f"kernel_vs_f32={err_kernel:.3e} xla_vs_f32={err_xla:.3e}")
+    ok &= ok16
+
+    # 3. packed scatter, logical d=64 (sub_k=2, in-kernel lane shift)
+    capL, dL = 1000, 64
+    vals = jnp.asarray(rng.normal(size=(capL, dL)), jnp.float32)
+    nphys = ((pk.phys_rows(capL, dL) + 7) // 8) * 8
+    packed = pk.pack_table(vals, nphys)
+    idsL = _zipf_ids(rng, n, capL)
+    deltasL = jnp.asarray(rng.normal(size=(n, dL)), jnp.float32)
+    wantL = vals.at[idsL].add(deltasL)
+    gotP = jax.jit(
+        lambda t, i, dl: pallas_scatter.scatter_add(
+            t, i, dl, interpret=not on_tpu,
+            sub_k=pk.pack_k(dL), sub_width=dL)
+    )(packed, idsL, deltasL)
+    ok &= check("scatter packed d64 sub_k=2 f32",
+                pk.unpack_table(gotP, capL, dL), wantL, 1e-3)
+
+    # 4. packed scatter, FM-shaped d=16 (sub_k=8)
+    capF, dF = 1000, 16
+    valsF = jnp.asarray(rng.normal(size=(capF, dF)), jnp.float32)
+    nphysF = ((pk.phys_rows(capF, dF) + 7) // 8) * 8
+    packedF = pk.pack_table(valsF, nphysF)
+    idsF = _zipf_ids(rng, n, capF)
+    deltasF = jnp.asarray(rng.normal(size=(n, dF)), jnp.float32)
+    wantF = valsF.at[idsF].add(deltasF)
+    gotF = jax.jit(
+        lambda t, i, dl: pallas_scatter.scatter_add(
+            t, i, dl, interpret=not on_tpu,
+            sub_k=pk.pack_k(dF), sub_width=dF)
+    )(packedF, idsF, deltasF)
+    ok &= check("scatter packed d16 sub_k=8 f32",
+                pk.unpack_table(gotF, capF, dF), wantF, 1e-3)
+
+    # 5. fused MF, dense d=128
+    capI, dI, nB = 1024, 128, n
+    u_tab = jnp.asarray(rng.normal(size=(512, dI)) * 0.1, jnp.float32)
+    i_tab = jnp.asarray(rng.normal(size=(capI, dI)) * 0.1, jnp.float32)
+    users = jnp.asarray(rng.integers(0, 512, nB), jnp.int32)
+    items = _zipf_ids(rng, nB, capI)
+    ratings = jnp.asarray(rng.normal(size=(nB,)), jnp.float32)
+    # XLA reference: snapshot-pull, SGD, sum-combined push
+    q = i_tab[items]
+    p = u_tab[users]
+    pred_want = jnp.sum(p * q, axis=1)
+    e = 0.05 * (ratings - pred_want)
+    ud = e[:, None] * q
+    idl = e[:, None] * p
+    uw = u_tab.at[users].add(ud)
+    iw = i_tab.at[items].add(idl)
+    nu, ni, pr = jax.jit(
+        lambda ut, it, us, im, r: pallas_mf.fused_mf_sgd(
+            ut, it, us, im, r, learning_rate=0.05,
+            interpret=not on_tpu)
+    )(u_tab, i_tab, users, items, ratings)
+    ok &= check("fused dense d128 pred", pr, pred_want, 1e-3)
+    ok &= check("fused dense d128 users", nu, uw, 1e-3)
+    ok &= check("fused dense d128 items", ni, iw, 1e-3)
+
+    # 6. fused MF, packed d=64
+    capI2, dI2 = 1000, 64
+    u2 = jnp.asarray(rng.normal(size=(512, dI2)) * 0.1, jnp.float32)
+    i2 = jnp.asarray(rng.normal(size=(capI2, dI2)) * 0.1, jnp.float32)
+    items2 = _zipf_ids(rng, nB, capI2)
+    nphys2 = ((pk.phys_rows(capI2, dI2) + 7) // 8) * 8
+    packed2 = pk.pack_table(i2, nphys2)
+    q2 = i2[items2]
+    p2 = u2[users]
+    pred2 = jnp.sum(p2 * q2, axis=1)
+    e2 = 0.05 * (ratings - pred2)
+    uw2 = u2.at[users].add(e2[:, None] * q2)
+    iw2 = i2.at[items2].add(e2[:, None] * p2)
+    nu2, np2_, pr2 = jax.jit(
+        lambda ut, it, us, im, r: pallas_mf.fused_mf_sgd_packed(
+            ut, it, us, im, r, capacity=capI2, dim=dI2,
+            learning_rate=0.05, interpret=not on_tpu)
+    )(u2, packed2, users, items2, ratings)
+    ok &= check("fused packed d64 pred", pr2, pred2, 1e-3)
+    ok &= check("fused packed d64 users", nu2, uw2, 1e-3)
+    ok &= check("fused packed d64 items",
+                pk.unpack_table(np2_, capI2, dI2), iw2, 1e-3)
+
+    print("ALL PASS" if ok else "SMOKE FAILURES")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,94 @@
+"""Tunnel-recovery watcher: probe the TPU backend periodically; the
+moment a chip answers, run the kernel smoke and (if it passes) the full
+``tpu_day1`` battery, then exit.
+
+The axon tunnel wedges without warning and recovers on its own — this
+watcher turns a recovered window into the round's evidence set with no
+human in the loop:
+
+    python benchmarks/tunnel_watch.py [--interval 300] [--max-hours 10]
+
+All output is appended to ``results/tpu/watch.log``; battery artifacts
+land in ``results/tpu/`` as usual.  The watcher itself never touches the
+backend in-process (a wedged init blocks forever holding the GIL) — it
+only launches subprocesses with timeouts.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "results", "tpu")
+
+
+def log(f, msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    f.write(line + "\n")
+    f.flush()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=300)
+    ap.add_argument("--max-hours", type=float, default=10.0)
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    args = ap.parse_args()
+
+    sys.path.insert(0, REPO)
+    from flink_parameter_server_tpu.utils.backend_probe import probe_backend
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    deadline = time.time() + args.max_hours * 3600
+    py = sys.executable
+    with open(os.path.join(OUT_DIR, "watch.log"), "a") as f:
+        log(f, f"watch start (interval={args.interval}s)")
+        while time.time() < deadline:
+            alive, detail = probe_backend(
+                timeout=args.probe_timeout, use_cache=False
+            )
+            if not alive:
+                log(f, f"probe: {detail}")
+                time.sleep(args.interval)
+                continue
+            log(f, "TPU LIVE — running kernel smoke")
+            smoke_out = os.path.join(OUT_DIR, "kernel_smoke.out")
+            with open(smoke_out, "w") as so:
+                try:
+                    rc = subprocess.call(
+                        [py, os.path.join(REPO, "benchmarks",
+                                          "kernel_smoke.py")],
+                        stdout=so, stderr=subprocess.STDOUT,
+                        timeout=1200, cwd=REPO,
+                    )
+                except subprocess.TimeoutExpired:
+                    rc = -1
+            log(f, f"kernel_smoke rc={rc} -> {smoke_out}")
+            if rc != 0:
+                # a failed Mosaic lowering would make the battery's
+                # pallas arms garbage — don't burn the window on it;
+                # surface the smoke output for diagnosis instead
+                log(f, "smoke FAILED — not running the battery; "
+                       "fix the kernels and rerun")
+                return 3
+            log(f, "running tpu_day1 battery")
+            try:
+                rc2 = subprocess.call(
+                    [py, os.path.join(REPO, "benchmarks", "tpu_day1.py")],
+                    stdout=f, stderr=subprocess.STDOUT,
+                    timeout=3 * 3600, cwd=REPO,
+                )
+            except subprocess.TimeoutExpired:
+                rc2 = -1
+            log(f, f"tpu_day1 rc={rc2}; watcher done")
+            return 0
+        log(f, "max-hours reached without a live TPU")
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -321,7 +321,7 @@ def push(
             elif spec.num_shards == 1:
                 if (
                     spec.layout == "packed"
-                    and spec.pack <= _pallas.MAX_INKERNEL_SUB_K
+                    and 1 < spec.pack <= _pallas.MAX_INKERNEL_SUB_K
                 ):
                     # logical ids + logical-width deltas: the kernel
                     # lane-shifts in-register, so the HBM delta buffer
@@ -335,8 +335,10 @@ def push(
                         sub_width=spec.row_width,
                     )
                 if spec.layout == "packed":
-                    # very narrow rows (e.g. scalars, pack=128): sub_k
-                    # unrolled in-kernel rolls would dominate — pre-shift
+                    # pack == 1 (row width 65..127 or a non-multiple of
+                    # 128 above it: lane-padded, not packed) and very
+                    # narrow rows (e.g. scalars, pack=128, where sub_k
+                    # unrolled in-kernel rolls would dominate): pre-shift
                     # XLA-side and scatter at physical granularity
                     s_ids, s_deltas = _phys_scatter_args(
                         spec, table, flat_ids, flat_deltas

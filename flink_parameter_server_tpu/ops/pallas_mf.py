@@ -458,6 +458,16 @@ def fused_mf_sgd_packed(
         interpret = jax.default_backend() != "tpu"
     k = pack_k(dim)
     nphys = packed_item_table.shape[0]
+    if capacity > nphys * k:
+        # a mismatched capacity would route lanes past the physical
+        # table — interpret mode clamps the window DMA and silently
+        # corrupts, so fail loudly here, and BEFORE window-align padding
+        # (padding grows the table, which would let an over-capacity
+        # claim slip past this guard into the zero-filled pad rows)
+        raise ValueError(
+            f"capacity {capacity} exceeds the packed table's "
+            f"{nphys} physical rows x k={k} = {nphys * k} logical rows"
+        )
     nphys8 = ((nphys + WINDOW - 1) // WINDOW) * WINDOW
     if nphys8 != nphys:
         # window-align with a pad copy, like fused_mf_sgd does for dense
@@ -471,16 +481,6 @@ def fused_mf_sgd_packed(
             chunk=chunk, interpret=interpret,
         )
         return new_users, new_packed[:nphys], pred
-    if capacity > packed_item_table.shape[0] * k:
-        # a mismatched capacity would route lanes past the physical
-        # table — interpret mode clamps the window DMA and silently
-        # corrupts, so fail loudly here (the dense path can't hit this:
-        # it derives capacity from the table shape)
-        raise ValueError(
-            f"capacity {capacity} exceeds the packed table's "
-            f"{packed_item_table.shape[0]} physical rows x k={k} = "
-            f"{packed_item_table.shape[0] * k} logical rows"
-        )
     n = items.shape[0]
     order, s_items, s_users, s_r, s_m, s_p = _sort_pad_lanes(
         capacity, user_table, users, items, ratings, mask, chunk

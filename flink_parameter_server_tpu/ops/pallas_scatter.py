@@ -249,10 +249,15 @@ def sorted_scatter_add_pallas(
             f"{capacity}. Use scatter_add(), which pads, or align the "
             f"table (ShardedParamStore does)."
         )
-    if not interpret and not supports_shape(capacity, dim):
+    # The Mosaic lane constraint applies to the PHYSICAL table width (the
+    # HBM DMA extent) — with sub_k > 1 the deltas stay at the narrow
+    # logical width by design (shifted in-register), so gate on the table.
+    hbm_width = table.shape[1] if sub_k > 1 else dim
+    if not interpret and not supports_shape(capacity, hbm_width):
         raise ValueError(
-            f"pallas scatter kernel needs dim % 128 == 0 on real Mosaic "
-            f"(lane alignment); got table ({capacity}, {dim}). Callers "
+            f"pallas scatter kernel needs the physical row width to be a "
+            f"multiple of 128 on real Mosaic (lane alignment); got table "
+            f"({capacity}, {table.shape[1]}), deltas width {dim}. Callers "
             f"should gate on supports_shape() and use the XLA scatter "
             f"path instead."
         )

@@ -218,3 +218,24 @@ def test_store_packed_pallas_single_shard_logical_path():
     np.testing.assert_allclose(
         np.asarray(a.values()), np.asarray(b.values()), rtol=1e-4, atol=1e-5
     )
+
+
+def test_packed_pack1_width_pallas_push():
+    """Regression: a packed store whose row width gives pack == 1
+    (65..127, lane-padded rather than packed) must route pallas pushes
+    through the XLA-side pre-shift — the in-kernel sub_k path would
+    reshape logical-width deltas against the 128-wide physical table."""
+    import numpy as np
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+
+    store = ShardedParamStore.create(
+        50, (100,), scatter_impl="pallas", layout="packed",
+    )
+    ids = jnp.asarray([0, 3, 3, 49], jnp.int32)
+    deltas = jnp.ones((4, 100), jnp.float32)
+    out = store.push(ids, deltas).values()
+    oracle = np.zeros((50, 100), np.float32)
+    for r in np.asarray(ids):
+        oracle[r] += 1.0
+    np.testing.assert_allclose(np.asarray(out), oracle, atol=1e-5)

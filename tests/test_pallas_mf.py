@@ -321,3 +321,23 @@ class TestPackedFused:
         assert np.isfinite(np.asarray(out["prediction"])).all()
         # training signal flows: the pushed table changed
         assert float(jnp.abs(new_table - store.table).max()) > 0
+
+
+def test_packed_capacity_guard_precedes_window_pad():
+    """Regression: the over-capacity guard must fire BEFORE window-align
+    padding — padding grows the table, which would let a capacity in
+    (nphys*k, nphys8*k] slip through into zero-filled pad rows and train
+    garbage silently."""
+    from flink_parameter_server_tpu.ops.pallas_mf import fused_mf_sgd_packed
+
+    # 50 phys rows (not 8-aligned -> pad path), k=2 at dim 64
+    packed = jnp.zeros((50, 128), jnp.float32)
+    users = jnp.zeros((4,), jnp.int32)
+    items = jnp.asarray([0, 1, 2, 105], jnp.int32)  # 105 > 50*2 - 1
+    u_tab = jnp.zeros((8, 64), jnp.float32)
+    r = jnp.zeros((4,), jnp.float32)
+    with pytest.raises(ValueError, match="exceeds the packed table"):
+        fused_mf_sgd_packed(
+            u_tab, packed, users, items, r,
+            capacity=110, dim=64, interpret=True,
+        )

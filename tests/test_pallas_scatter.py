@@ -143,3 +143,41 @@ def test_unaligned_capacity_raises_in_core_but_pads_in_wrapper():
         )
     out = scatter_add(table, ids, deltas, chunk=8, interpret=True)
     np.testing.assert_allclose(np.asarray(out), _oracle(table, ids, deltas))
+
+
+def test_compiled_gate_checks_physical_width_for_packed():
+    """Regression (round-2 on-chip failure): the Mosaic lane gate must
+    check the PHYSICAL table width, not the logical delta width — the
+    packed path (sub_k > 1) feeds narrow logical deltas by design and is
+    always eligible (table width 128 by construction).
+
+    jax.eval_shape runs the Python-level gate at trace time without
+    lowering to Mosaic, so this pins the compiled-path (interpret=False)
+    gating on any backend.
+    """
+    from flink_parameter_server_tpu.ops.pallas_scatter import (
+        sorted_scatter_add_pallas,
+    )
+
+    packed_table = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+    ids = jax.ShapeDtypeStruct((16,), jnp.int32)
+    narrow_deltas = jax.ShapeDtypeStruct((16, 64), jnp.float32)
+
+    # packed: logical width 64, physical 128 — must pass the gate
+    out = jax.eval_shape(
+        lambda t, i, d: sorted_scatter_add_pallas(
+            t, i, d, chunk=8, interpret=False, sub_k=2, sub_width=64
+        ),
+        packed_table, ids, narrow_deltas,
+    )
+    assert out.shape == (64, 128)
+
+    # dense: a genuinely 64-wide table must still be rejected
+    dense_table = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        jax.eval_shape(
+            lambda t, i, d: sorted_scatter_add_pallas(
+                t, i, d, chunk=8, interpret=False
+            ),
+            dense_table, ids, narrow_deltas,
+        )
