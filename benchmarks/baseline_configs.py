@@ -251,18 +251,22 @@ def bench_lm():
     )
 
     tpu = _is_tpu()
-    # transformer-base-ish on TPU; a miniature on the 1-core CPU host
+    # transformer-base-ish on TPU; a miniature on the 1-core CPU host.
+    # FPS_LM_BATCH / FPS_LM_SEQ / FPS_LM_FLASH (auto|on|off) sweep the
+    # MFU levers (workload per step; splash-vs-reference attention).
+    B = int(os.environ.get("FPS_LM_BATCH", 16 if tpu else 4))
+    T = int(os.environ.get("FPS_LM_SEQ", 512 if tpu else 64))
+    flash = os.environ.get("FPS_LM_FLASH", "auto")
     cfg = TransformerConfig(
         vocab_size=32_000 if tpu else 1_000,
         d_model=512 if tpu else 64,
         n_layers=6 if tpu else 2,
         n_heads=8 if tpu else 4,
         d_ff=2048 if tpu else 128,
-        max_seq=512 if tpu else 64,
+        max_seq=T,
         dtype=jnp.bfloat16 if tpu else jnp.float32,
+        flash_attention=flash,
     )
-    B = 16 if tpu else 4
-    T = cfg.max_seq
 
     params = init_params(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4)
@@ -285,10 +289,18 @@ def bench_lm():
     flops_per_step = 6 * n_params * B * T  # fwd+bwd dense-matmul estimate
     peak = _peak_flops_bf16()
     mfu = (flops_per_step / dt / peak) if peak else None
+    # record which attention path actually ran, not the raw knob —
+    # 'auto' can resolve either way (same principle as _resolved())
+    from flink_parameter_server_tpu.ops.flash_attention import (
+        supports_shape as flash_supports,
+    )
+
+    flash_ran = flash != "off" and tpu and flash_supports(T, cfg.head_dim)
     _row(
         "5-transformer-lm-dense", tokens_per_sec, "tokens/sec",
         batch=B, seq=T, n_params=n_params,
         mfu=round(mfu, 4) if mfu else None,
+        flash_attention="on" if flash_ran else "off",
     )
 
 

@@ -23,6 +23,11 @@ sys.path.insert(
 from flink_parameter_server_tpu.utils.backend_probe import probe_backend
 
 if "--cpu" in sys.argv or not probe_backend()[0]:
+    if "--require-tpu" in sys.argv:
+        # tunnel_watch gates the 3-hour battery on this script's exit
+        # code — a CPU-fallback "ALL PASS" must not green-light it
+        print("no live TPU and --require-tpu set", file=sys.stderr)
+        raise SystemExit(2)
     jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp  # noqa: E402
@@ -30,6 +35,7 @@ import numpy as np  # noqa: E402
 
 from flink_parameter_server_tpu.ops import packed as pk  # noqa: E402
 from flink_parameter_server_tpu.ops import pallas_mf, pallas_scatter  # noqa: E402
+from flink_parameter_server_tpu.ops.pallas_scatter import WINDOW  # noqa: E402
 
 
 def _zipf_ids(rng, n, cap):
@@ -89,7 +95,7 @@ def main():
     # 3. packed scatter, logical d=64 (sub_k=2, in-kernel lane shift)
     capL, dL = 1000, 64
     vals = jnp.asarray(rng.normal(size=(capL, dL)), jnp.float32)
-    nphys = ((pk.phys_rows(capL, dL) + 7) // 8) * 8
+    nphys = -(-pk.phys_rows(capL, dL) // WINDOW) * WINDOW
     packed = pk.pack_table(vals, nphys)
     idsL = _zipf_ids(rng, n, capL)
     deltasL = jnp.asarray(rng.normal(size=(n, dL)), jnp.float32)
@@ -105,7 +111,7 @@ def main():
     # 4. packed scatter, FM-shaped d=16 (sub_k=8)
     capF, dF = 1000, 16
     valsF = jnp.asarray(rng.normal(size=(capF, dF)), jnp.float32)
-    nphysF = ((pk.phys_rows(capF, dF) + 7) // 8) * 8
+    nphysF = -(-pk.phys_rows(capF, dF) // WINDOW) * WINDOW
     packedF = pk.pack_table(valsF, nphysF)
     idsF = _zipf_ids(rng, n, capF)
     deltasF = jnp.asarray(rng.normal(size=(n, dF)), jnp.float32)
@@ -148,7 +154,7 @@ def main():
     u2 = jnp.asarray(rng.normal(size=(512, dI2)) * 0.1, jnp.float32)
     i2 = jnp.asarray(rng.normal(size=(capI2, dI2)) * 0.1, jnp.float32)
     items2 = _zipf_ids(rng, nB, capI2)
-    nphys2 = ((pk.phys_rows(capI2, dI2) + 7) // 8) * 8
+    nphys2 = -(-pk.phys_rows(capI2, dI2) // WINDOW) * WINDOW
     packed2 = pk.pack_table(i2, nphys2)
     q2 = i2[items2]
     p2 = u2[users]
@@ -165,6 +171,38 @@ def main():
     ok &= check("fused packed d64 users", nu2, uw2, 1e-3)
     ok &= check("fused packed d64 items",
                 pk.unpack_table(np2_, capI2, dI2), iw2, 1e-3)
+
+    # 7. splash flash attention (ops/flash_attention.py) — fwd + grad
+    # vs the O(T²) reference, bf16 at LM-bench-like shapes
+    from flink_parameter_server_tpu.ops.flash_attention import flash_mha
+    from flink_parameter_server_tpu.parallel.ring_attention import (
+        reference_attention,
+    )
+
+    Bf, Tf, Hf, Df = 2, 512 if on_tpu else 128, 4, 64
+    mk = lambda: jnp.asarray(
+        rng.normal(size=(Bf, Tf, Hf, Df)) * 0.5, jnp.bfloat16
+    )
+    qf, kf, vf = mk(), mk(), mk()
+    got_f = jax.jit(
+        lambda a, b, c: flash_mha(a, b, c, interpret=not on_tpu)
+    )(qf, kf, vf)
+    want_f = reference_attention(qf, kf, vf)
+    ok &= check("flash_mha bf16 fwd", got_f, want_f, 0.03)
+
+    def _gsum(fn):
+        return jax.jit(jax.grad(
+            lambda a, b, c: fn(a, b, c).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2),
+        ))
+
+    gf = _gsum(lambda a, b, c: flash_mha(a, b, c, interpret=not on_tpu))(
+        qf, kf, vf
+    )
+    gr = _gsum(reference_attention)(qf, kf, vf)
+    ok &= check("flash_mha bf16 grad_q", gf[0], gr[0], 0.05)
+    ok &= check("flash_mha bf16 grad_k", gf[1], gr[1], 0.05)
+    ok &= check("flash_mha bf16 grad_v", gf[2], gr[2], 0.05)
 
     print("ALL PASS" if ok else "SMOKE FAILURES")
     return 0 if ok else 1

@@ -157,6 +157,26 @@ def main():
         )
     )
 
+    # 4b. transformer-LM MFU levers: bigger per-step workload, and the
+    # splash flash-attention win at long sequence (auto vs off A/B)
+    for tag, lm_env in (
+        ("lm_b64", {"FPS_LM_BATCH": "64"}),
+        ("lm_t2048_flash", {"FPS_LM_BATCH": "8", "FPS_LM_SEQ": "2048",
+                            "FPS_LM_FLASH": "auto"}),
+        ("lm_t2048_noflash", {"FPS_LM_BATCH": "8", "FPS_LM_SEQ": "2048",
+                              "FPS_LM_FLASH": "off"}),
+    ):
+        env_lm = dict(os.environ)
+        env_lm.update(lm_env)
+        results.append(
+            run_job(
+                f"baseline_{tag}",
+                [py, os.path.join(REPO, "benchmarks",
+                                  "baseline_configs.py"), "lm"],
+                int(600 * scale), OUT_DIR, env=env_lm,
+            )
+        )
+
     # 5. profiler trace of the MF step (the fused-kernel decision input).
     # One untraced call first: same shapes -> the jit cache is warm, so
     # the trace captures steady-state steps, not compilation

@@ -61,7 +61,8 @@ def main():
                 try:
                     rc = subprocess.call(
                         [py, os.path.join(REPO, "benchmarks",
-                                          "kernel_smoke.py")],
+                                          "kernel_smoke.py"),
+                         "--require-tpu"],
                         stdout=so, stderr=subprocess.STDOUT,
                         timeout=1200, cwd=REPO,
                     )

@@ -47,6 +47,13 @@ class TransformerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    # Single-chip attention implementation (ops/flash_attention.py):
+    # "auto" uses the TPU splash flash kernel when eligible (TPU backend,
+    # no mesh, T % 128 == 0 and head_dim % 64 == 0) and the O(T²)
+    # reference path otherwise; "on" forces it (raising if ineligible);
+    # "off" always uses the reference path.  Ring attention (sp meshes)
+    # takes precedence — this knob only governs the unsharded fallback.
+    flash_attention: str = "auto"
     # rematerialise each block in the backward pass (jax.checkpoint):
     # activation memory per layer drops from O(T·d_ff) to O(T·d_model),
     # the long-context lever (docs/scaling.md "Memory levers")
@@ -63,6 +70,11 @@ class TransformerConfig:
     moe_capacity: int = 0
 
     def __post_init__(self):
+        if self.flash_attention not in ("auto", "on", "off"):
+            raise ValueError(
+                f"flash_attention must be 'auto', 'on' or 'off', got "
+                f"{self.flash_attention!r}"
+            )
         if self.num_experts > 0:
             assert self.moe_capacity > 0, (
                 "num_experts > 0 requires moe_capacity > 0 (capacity 0 "
@@ -176,6 +188,46 @@ def _rope(x: Array, positions: Array) -> Array:
     return out.astype(x.dtype)
 
 
+def _unsharded_attention(
+    q: Array, k: Array, v: Array, cfg: TransformerConfig,
+    mesh: Optional[Mesh],
+) -> Array:
+    """The non-ring attention path: splash flash kernel when eligible
+    (see TransformerConfig.flash_attention), else the O(T²) reference.
+
+    The flash path is restricted to mesh-free (single-chip jit) runs: a
+    pallas_call under auto-sharded pjit would force XLA to gather the
+    sharded batch.  dp/sp meshes keep the reference/ring paths."""
+    from ..ops import flash_attention as _flash
+
+    T, Dh = q.shape[1], q.shape[3]
+    if cfg.flash_attention == "off":
+        return reference_attention(q, k, v)
+    eligible = (
+        mesh is None
+        and jax.default_backend() == "tpu"
+        and _flash.supports_shape(T, Dh)
+    )
+    if cfg.flash_attention == "on":
+        if mesh is not None:
+            raise ValueError(
+                "flash_attention='on' is single-chip only (use ring "
+                "attention / the reference path on meshes)"
+            )
+        if jax.default_backend() != "tpu":
+            # interpret-mode pallas at model sizes is an effective hang;
+            # tests that want it call flash_mha(interpret=True) directly
+            raise ValueError(
+                "flash_attention='on' requires the TPU backend (the "
+                "splash kernel would run in interpret mode here); use "
+                "'auto' to fall back gracefully"
+            )
+        return _flash.flash_mha(q, k, v)
+    return _flash.flash_mha(q, k, v) if eligible else (
+        reference_attention(q, k, v)
+    )
+
+
 def _apply_block(
     x: Array,
     layer: Dict,
@@ -226,7 +278,7 @@ def _apply_block(
             tp_axis=cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None,
         )
     else:
-        attn = reference_attention(q, k, v)
+        attn = _unsharded_attention(q, k, v, cfg, mesh)
     attn = attn.reshape(B, T, H * Dh)
     x = x + attn @ layer["wo"]
     if constrain is not None:

@@ -1,0 +1,107 @@
+"""Flash attention for the dense transformer path (TPU splash kernel).
+
+Reference parity: the reference has nothing sequence-related (SURVEY.md
+§2 "Sequence/context parallelism": absent) — this is a beyond-reference
+TPU-native component backing BASELINE config 5 (transformer-LM) and the
+long-context story.  The O(T²) scores matrix of
+:func:`..parallel.ring_attention.reference_attention` never touches HBM:
+the splash kernel (JAX's production TPU flash attention,
+``jax.experimental.pallas.ops.tpu.splash_attention``) streams K/V blocks
+through VMEM with an online softmax, skipping fully-masked blocks of the
+causal mask entirely (~2× fewer FLOPs at long T), with a custom VJP for
+training.
+
+Integration contract (matching ``reference_attention``):
+
+  * layout ``(B, T, H, D)`` in, ``(B, T, H, D)`` out (the kernel's
+    native layout is ``(H, T, D)``; batch is vmapped),
+  * causal masking, ``1/sqrt(D)`` scaling applied to q (the kernel does
+    NOT scale internally),
+  * fp32 softmax accumulation regardless of input dtype (kernel-internal).
+
+``supports_shape`` gates the compiled path conservatively (T a multiple
+of 128 sublane-tiles, D a multiple of 64 lanes); the on-chip constraint
+set is re-measured by ``benchmarks/kernel_smoke.py`` whenever a TPU is
+live.  Off-TPU the caller should prefer ``reference_attention`` —
+interpret mode exists for parity tests, not perf.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def supports_shape(seq_len: int, head_dim: int) -> bool:
+    """True if the compiled splash kernel supports (T, D)."""
+    return seq_len % 128 == 0 and head_dim % 64 == 0 and seq_len >= 128
+
+
+@functools.lru_cache(maxsize=32)
+def _make_kernel(seq_len: int, num_heads: int, interpret: bool):
+    """Kernel construction is Python-side work (mask metadata build) —
+    cache per static shape so repeated traces reuse it.
+
+    ``ensure_compile_time_eval``: the splash builder materialises small
+    mask arrays; when the first call happens inside a jit trace those
+    would be tracers, and caching a tracer-carrying kernel poisons every
+    later trace (UnexpectedTracerError).  Forcing compile-time eval makes
+    the cached kernel concrete regardless of caller context."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    with jax.ensure_compile_time_eval():
+        mask = sm.MultiHeadMask(
+            [sm.CausalMask((seq_len, seq_len)) for _ in range(num_heads)]
+        )
+        return sk.make_splash_mha_single_device(
+            mask=mask, interpret=interpret
+        )
+
+
+def flash_mha(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Causal flash attention on ``(B, T, H, D)`` tensors.
+
+    Drop-in for ``reference_attention(q, k, v)`` (causal=True) — parity
+    asserted to kernel-accumulation tolerance in
+    tests/test_flash_attention.py, gradients included.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, T, H, D = q.shape
+    if not supports_shape(T, D):
+        raise ValueError(
+            f"flash_mha needs T % 128 == 0 and D % 64 == 0; got T={T}, "
+            f"D={D}. Callers should gate on supports_shape() and fall "
+            f"back to reference_attention."
+        )
+    kernel = _make_kernel(T, H, interpret)
+    # scale q in f32 (a bf16 pre-scale would round before the kernel's
+    # f32 accumulation even starts)
+    scale = 1.0 / (D**0.5)
+    q_scaled = (q.astype(jnp.float32) * scale).astype(q.dtype)
+
+    def one(qb, kb, vb):
+        out = kernel(
+            qb.transpose(1, 0, 2),  # (H, T, D)
+            kb.transpose(1, 0, 2),
+            vb.transpose(1, 0, 2),
+        )
+        return out.transpose(1, 0, 2)
+
+    return jax.vmap(one)(q_scaled, k, v).astype(v.dtype)
+
+
+__all__ = ["flash_mha", "supports_shape"]
