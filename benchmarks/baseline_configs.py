@@ -290,12 +290,13 @@ def bench_lm():
     peak = _peak_flops_bf16()
     mfu = (flops_per_step / dt / peak) if peak else None
     # record which attention path actually ran, not the raw knob —
-    # 'auto' can resolve either way (same principle as _resolved())
+    # 'auto' can resolve either way (same principle as _resolved());
+    # flash_eligible is the SAME predicate the model dispatches on
     from flink_parameter_server_tpu.ops.flash_attention import (
-        supports_shape as flash_supports,
+        eligible as flash_eligible,
     )
 
-    flash_ran = flash != "off" and tpu and flash_supports(T, cfg.head_dim)
+    flash_ran = flash != "off" and flash_eligible(T, cfg.head_dim)
     _row(
         "5-transformer-lm-dense", tokens_per_sec, "tokens/sec",
         batch=B, seq=T, n_params=n_params,

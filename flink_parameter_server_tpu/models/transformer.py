@@ -203,11 +203,7 @@ def _unsharded_attention(
     T, Dh = q.shape[1], q.shape[3]
     if cfg.flash_attention == "off":
         return reference_attention(q, k, v)
-    eligible = (
-        mesh is None
-        and jax.default_backend() == "tpu"
-        and _flash.supports_shape(T, Dh)
-    )
+    eligible = _flash.eligible(T, Dh, mesh)
     if cfg.flash_attention == "on":
         if mesh is not None:
             raise ValueError(
@@ -380,7 +376,14 @@ def forward_pipelined(
         params["layers"], S, mesh=mesh, pp_axis=cfg.pp_axis
     )
 
-    block_cfg = dataclasses.replace(cfg, use_ring_attention=False)
+    # stage blocks run INSIDE a shard_map over the pp mesh with
+    # mesh=None — without pinning flash off, the "mesh is None implies
+    # single-chip" gate in _unsharded_attention would let the splash
+    # kernel fire inside the pipeline (an un-validated composition);
+    # attention inside stages is ring (sp) or the reference path
+    block_cfg = dataclasses.replace(
+        cfg, use_ring_attention=False, flash_attention="off"
+    )
     use_sp = bool(
         cfg.use_ring_attention
         and cfg.sp_axis

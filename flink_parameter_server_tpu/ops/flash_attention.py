@@ -41,6 +41,18 @@ def supports_shape(seq_len: int, head_dim: int) -> bool:
     return seq_len % 128 == 0 and head_dim % 64 == 0 and seq_len >= 128
 
 
+def eligible(seq_len: int, head_dim: int, mesh=None) -> bool:
+    """The 'auto' gate: compiled flash is used iff this holds.  ONE
+    home for the predicate — the transformer's attention dispatch and
+    the benchmarks' run-labeling both call it (a drifted copy would
+    mislabel A/B rows)."""
+    return (
+        mesh is None
+        and jax.default_backend() == "tpu"
+        and supports_shape(seq_len, head_dim)
+    )
+
+
 @functools.lru_cache(maxsize=32)
 def _make_kernel(seq_len: int, num_heads: int, interpret: bool):
     """Kernel construction is Python-side work (mask metadata build) —
@@ -104,4 +116,4 @@ def flash_mha(
     return jax.vmap(one)(q_scaled, k, v).astype(v.dtype)
 
 
-__all__ = ["flash_mha", "supports_shape"]
+__all__ = ["flash_mha", "supports_shape", "eligible"]

@@ -5,8 +5,10 @@ Launched (twice) by tests/test_multihost.py with:
 
 Exercises the real multi-process path of parallel/multihost.py on the CPU
 backend: distributed init, global mesh construction with the ICI/DCN
-axis-layout rule, per-process batch slicing, and one cross-process psum
-through a pjit'd computation.  Prints "MULTIHOST_OK <proc_id> <sum>" on
+axis-layout rule, per-process batch slicing, one cross-process psum
+through a pjit'd computation, and a cross-process ShardedParamStore
+(ps axis spanning both processes) with a jitted push+pull checked
+against a numpy oracle.  Prints "MULTIHOST_OK <proc_id> <sum>" on
 success; any assertion/exception exits nonzero.
 """
 import sys
@@ -67,5 +69,50 @@ total_sum = jax.jit(
 expected = sum(d.id for d in jax.devices()) * 4.0
 got = float(np.asarray(total_sum))
 assert got == expected, (got, expected)
+
+# --- a parameter store sharded ACROSS the two processes (DCN) ---------
+# The reference's scale-out story is "add TaskManagers and the keyed
+# routing spans them"; the analogue: a ShardedParamStore whose ps axis
+# spans both OS processes, driven by a jitted push + pull whose
+# gather/scatter collectives cross the process boundary.
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from flink_parameter_server_tpu.core import store as store_mod  # noqa: E402
+from flink_parameter_server_tpu.core.store import (  # noqa: E402
+    ShardedParamStore,
+)
+
+mesh_ps = multihost.make_multihost_mesh(
+    dp=1, ps=total, devices=jax.devices()
+)
+with_ps = ShardedParamStore.create(
+    64, (8,),
+    init_fn=lambda ids: jnp.zeros(ids.shape + (8,), jnp.float32),
+    mesh=mesh_ps,
+)
+spec = with_ps.spec
+# identical on every process (same seed) — the multi-process contract
+# for replicated jit inputs
+host_rng = np.random.default_rng(7)
+ids = host_rng.integers(0, 64, 32).astype(np.int32)
+deltas = host_rng.normal(size=(32, 8)).astype(np.float32)
+
+rep = NamedSharding(mesh_ps, P())
+push_pull_sum = jax.jit(
+    lambda t, i, d: jnp.sum(
+        store_mod.pull(spec, store_mod.push(spec, t, i, d), i)
+    ),
+    in_shardings=(spec.sharding(), rep, rep),
+    out_shardings=rep,
+)
+got_sum = float(np.asarray(push_pull_sum(with_ps.table, ids, deltas)))
+
+oracle = np.zeros((64, 8), np.float32)
+for i, r in enumerate(ids):
+    oracle[r] += deltas[i]
+want_sum = float(oracle[ids].sum())
+assert abs(got_sum - want_sum) < 1e-3 * max(1.0, abs(want_sum)), (
+    got_sum, want_sum
+)
 
 print(f"MULTIHOST_OK {proc_id} {got}", flush=True)

@@ -5,8 +5,11 @@ The reference proves its Netty/TaskManager scale-out on an in-JVM
 MiniCluster; the analogue here is two *real* OS processes coordinated by
 ``jax.distributed`` on the CPU backend (2 virtual devices each → a
 2-host × 2-device global mesh), running parallel/multihost.py end to
-end: init, DCN/ICI-aware mesh layout, ingestion slicing, and one
-cross-process collective.
+end: init, DCN/ICI-aware mesh layout, ingestion slicing, one
+cross-process collective, and a ShardedParamStore whose ps axis spans
+both processes driven by a jitted push+pull (the scatter/gather
+collectives cross the process boundary — the reference's
+"keyed routing spans TaskManagers" analogue).
 
 Env-robustness: children are launched with the axon sitecustomize dir
 stripped from PYTHONPATH and JAX_PLATFORMS=cpu so the wedged-TPU-tunnel
