@@ -290,13 +290,20 @@ def bench_lm():
     peak = _peak_flops_bf16()
     mfu = (flops_per_step / dt / peak) if peak else None
     # record which attention path actually ran, not the raw knob —
-    # 'auto' can resolve either way (same principle as _resolved());
-    # flash_eligible is the SAME predicate the model dispatches on
+    # 'auto' can resolve either way (same principle as _resolved()).
+    # Mirror the model's dispatch (meshless OR dp-only flash); this
+    # bench is meshless, so eligible() decides and eligible_dp() is
+    # vacuously False — but keep both so a future dp-mesh bench arm
+    # cannot silently mislabel.
     from flink_parameter_server_tpu.ops.flash_attention import (
         eligible as flash_eligible,
+        eligible_dp as flash_eligible_dp,
     )
 
-    flash_ran = flash != "off" and flash_eligible(T, cfg.head_dim)
+    flash_ran = flash != "off" and (
+        flash_eligible(T, cfg.head_dim)
+        or flash_eligible_dp(T, cfg.head_dim, B, None)
+    )
     _row(
         "5-transformer-lm-dense", tokens_per_sec, "tokens/sec",
         batch=B, seq=T, n_params=n_params,

@@ -204,6 +204,21 @@ def main():
     ok &= check("flash_mha bf16 grad_k", gf[1], gr[1], 0.05)
     ok &= check("flash_mha bf16 grad_v", gf[2], gr[2], 0.05)
 
+    # 8. flash under shard_map (the dp deployment) — dp=1 degenerate
+    # mesh on a single chip still compiles the shard_map+splash
+    # composition for real
+    from jax.sharding import Mesh
+
+    from flink_parameter_server_tpu.ops.flash_attention import flash_mha_dp
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("dp", "ps"))
+    got_dp = jax.jit(
+        lambda a, b, c: flash_mha_dp(
+            a, b, c, mesh=mesh1, interpret=not on_tpu
+        )
+    )(qf, kf, vf)
+    ok &= check("flash_mha_dp shard_map bf16 fwd", got_dp, want_f, 0.03)
+
     print("ALL PASS" if ok else "SMOKE FAILURES")
     return 0 if ok else 1
 

@@ -47,12 +47,14 @@ class TransformerConfig:
     max_seq: int = 1024
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
-    # Single-chip attention implementation (ops/flash_attention.py):
-    # "auto" uses the TPU splash flash kernel when eligible (TPU backend,
-    # no mesh, T % 128 == 0 and head_dim % 64 == 0) and the O(T²)
+    # Attention implementation for the non-ring path
+    # (ops/flash_attention.py): "auto" uses the TPU splash flash kernel
+    # when eligible (TPU backend, T % 128 == 0, head_dim % 64 == 0, and
+    # either no mesh or a dp-ONLY mesh dividing the batch — dp shards
+    # run the kernel independently under shard_map) and the O(T²)
     # reference path otherwise; "on" forces it (raising if ineligible);
     # "off" always uses the reference path.  Ring attention (sp meshes)
-    # takes precedence — this knob only governs the unsharded fallback.
+    # takes precedence — this knob only governs the non-ring fallback.
     flash_attention: str = "auto"
     # rematerialise each block in the backward pass (jax.checkpoint):
     # activation memory per layer drops from O(T·d_ff) to O(T·d_model),
@@ -195,33 +197,42 @@ def _unsharded_attention(
     """The non-ring attention path: splash flash kernel when eligible
     (see TransformerConfig.flash_attention), else the O(T²) reference.
 
-    The flash path is restricted to mesh-free (single-chip jit) runs: a
-    pallas_call under auto-sharded pjit would force XLA to gather the
-    sharded batch.  dp/sp meshes keep the reference/ring paths."""
+    Flash runs meshless (single-chip jit), or on a dp-ONLY mesh via a
+    per-shard shard_map (attention never mixes batch rows).  sp/tp/pp
+    meshes keep the ring/reference paths — a bare pallas_call under
+    auto-sharded pjit on those would force XLA to gather the batch."""
     from ..ops import flash_attention as _flash
 
-    T, Dh = q.shape[1], q.shape[3]
+    B, T, Dh = q.shape[0], q.shape[1], q.shape[3]
     if cfg.flash_attention == "off":
         return reference_attention(q, k, v)
-    eligible = _flash.eligible(T, Dh, mesh)
-    if cfg.flash_attention == "on":
-        if mesh is not None:
-            raise ValueError(
-                "flash_attention='on' is single-chip only (use ring "
-                "attention / the reference path on meshes)"
-            )
-        if jax.default_backend() != "tpu":
-            # interpret-mode pallas at model sizes is an effective hang;
-            # tests that want it call flash_mha(interpret=True) directly
-            raise ValueError(
-                "flash_attention='on' requires the TPU backend (the "
-                "splash kernel would run in interpret mode here); use "
-                "'auto' to fall back gracefully"
-            )
+    if _flash.eligible(T, Dh, mesh):
         return _flash.flash_mha(q, k, v)
-    return _flash.flash_mha(q, k, v) if eligible else (
-        reference_attention(q, k, v)
+    # dp dispatch honors the config's axis naming: dp_axis=None means
+    # "no data-parallel axis" — never probe a literal 'dp' in that case
+    # (same convention as the activation-sharding constraints below)
+    dp_axis = (
+        cfg.dp_axis
+        if (mesh is not None and cfg.dp_axis
+            and cfg.dp_axis in mesh.axis_names)
+        else None
     )
+    if dp_axis is not None and _flash.eligible_dp(T, Dh, B, mesh, dp_axis):
+        return _flash.flash_mha_dp(q, k, v, mesh=mesh, dp_axis=dp_axis)
+    if cfg.flash_attention == "on":
+        # interpret-mode pallas at model sizes is an effective hang, and
+        # a silent reference fallback would mislabel benchmarks — "on"
+        # means the kernel or an error.  (Tests that want interpret mode
+        # call flash_mha(interpret=True) directly.)
+        raise ValueError(
+            f"flash_attention='on' but the flash path is ineligible "
+            f"(backend={jax.default_backend()!r}, T={T}, head_dim={Dh}, "
+            f"mesh={None if mesh is None else dict(mesh.shape)}); flash "
+            f"needs the TPU backend, T % 128 == 0, head_dim % 64 == 0, "
+            f"and no mesh or a dp-only mesh dividing the batch. Use "
+            f"'auto' to fall back gracefully."
+        )
+    return reference_attention(q, k, v)
 
 
 def _apply_block(
@@ -380,7 +391,15 @@ def forward_pipelined(
     # mesh=None — without pinning flash off, the "mesh is None implies
     # single-chip" gate in _unsharded_attention would let the splash
     # kernel fire inside the pipeline (an un-validated composition);
-    # attention inside stages is ring (sp) or the reference path
+    # attention inside stages is ring (sp) or the reference path.
+    # "on" must not silently become the reference path — same contract
+    # as _unsharded_attention: the kernel or an error.
+    if cfg.flash_attention == "on":
+        raise ValueError(
+            "flash_attention='on' is not supported in forward_pipelined "
+            "(the splash kernel inside pipeline stages is an "
+            "un-validated composition); use 'auto' or 'off'"
+        )
     block_cfg = dataclasses.replace(
         cfg, use_ring_attention=False, flash_attention="off"
     )

@@ -53,6 +53,67 @@ def eligible(seq_len: int, head_dim: int, mesh=None) -> bool:
     )
 
 
+def _dp_only_mesh(mesh, dp_axis: str) -> bool:
+    return (
+        mesh is not None
+        and dp_axis in mesh.axis_names
+        and all(
+            size == 1
+            for name, size in mesh.shape.items()
+            if name != dp_axis
+        )
+    )
+
+
+def eligible_dp(
+    seq_len: int, head_dim: int, batch: int, mesh, dp_axis: str = "dp"
+) -> bool:
+    """The 'auto' gate for DATA-PARALLEL meshes: flash runs per dp shard
+    under shard_map (attention is batch-elementwise, so a dp-only mesh
+    needs no cross-shard traffic).  sp/tp/pp meshes stay on their ring /
+    reference paths."""
+    return (
+        _dp_only_mesh(mesh, dp_axis)
+        and jax.default_backend() == "tpu"
+        and supports_shape(seq_len, head_dim)
+        and batch % mesh.shape[dp_axis] == 0
+    )
+
+
+def flash_mha_dp(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    mesh,
+    dp_axis: str = "dp",
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Causal flash attention with the batch dim sharded over ``dp``:
+    one kernel invocation per shard, no collectives (attention never
+    mixes batch rows).  Inside a jit whose activations are already
+    dp-sharded this is a sharding-preserving no-op wrapper around the
+    kernel — the multi-chip deployment of BASELINE config 5."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    B = q.shape[0]
+    dp = mesh.shape[dp_axis]
+    if B % dp != 0:
+        raise ValueError(
+            f"flash_mha_dp needs batch {B} divisible by dp={dp}"
+        )
+    spec = P(dp_axis, None, None, None)
+    fn = shard_map(
+        lambda a, b, c: flash_mha(a, b, c, interpret=interpret),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
 @functools.lru_cache(maxsize=32)
 def _make_kernel(seq_len: int, num_heads: int, interpret: bool):
     """Kernel construction is Python-side work (mask metadata build) —
@@ -116,4 +177,10 @@ def flash_mha(
     return jax.vmap(one)(q_scaled, k, v).astype(v.dtype)
 
 
-__all__ = ["flash_mha", "supports_shape", "eligible"]
+__all__ = [
+    "flash_mha",
+    "flash_mha_dp",
+    "supports_shape",
+    "eligible",
+    "eligible_dp",
+]

@@ -120,7 +120,7 @@ def test_flash_on_requires_tpu(rng):
     )
     params = init_params(jax.random.PRNGKey(0), cfg)
     tokens = jnp.asarray(rng.integers(0, 64, (1, 128)), jnp.int32)
-    with pytest.raises(ValueError, match="requires the TPU backend"):
+    with pytest.raises(ValueError, match="ineligible"):
         forward(params, tokens, cfg)
 
 
@@ -151,3 +151,98 @@ def test_kernel_cache_safe_when_first_use_is_jitted(rng):
         lambda a: flash_mha(a, k, v, interpret=True).sum()
     ))(q)
     assert out.shape == q.shape and g.shape == q.shape
+
+
+def test_flash_mha_dp_parity(rng):
+    """flash under shard_map over a dp-only mesh == the reference on the
+    full batch (attention never mixes batch rows)."""
+    from jax.sharding import Mesh
+
+    from flink_parameter_server_tpu.ops.flash_attention import (
+        eligible_dp,
+        flash_mha_dp,
+    )
+
+    devs = np.array(jax.devices()[:2]).reshape(2, 1)
+    mesh = Mesh(devs, ("dp", "ps"))
+    q, k, v = _qkv(rng, 4, 128, 2, 64, jnp.float32)
+    got = flash_mha_dp(q, k, v, mesh=mesh, interpret=True)
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5
+    )
+    # gating: dp-only requirement and batch divisibility (backend check
+    # is False on CPU regardless — assert the structural parts)
+    assert not eligible_dp(128, 64, 3, mesh)  # 3 % 2 != 0
+    sp_mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "sp"))
+    assert not eligible_dp(128, 64, 4, sp_mesh)  # sp axis > 1
+
+
+def test_model_level_dp_flash_gating(rng, monkeypatch):
+    """forward() on a dp-only mesh routes through flash_mha_dp when
+    'auto' resolves eligible (emulated TPU), matching the reference."""
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    import flink_parameter_server_tpu.models.transformer as tr
+    import flink_parameter_server_tpu.ops.flash_attention as fa
+    from flink_parameter_server_tpu.models.transformer import (
+        TransformerConfig,
+        forward,
+        init_params,
+    )
+
+    cfg_off = TransformerConfig(
+        vocab_size=64, d_model=128, n_heads=2, n_layers=1, d_ff=128,
+        max_seq=128, dtype=jnp.float32, flash_attention="off",
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg_off)
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 128)), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("dp", "ps"))
+
+    logits_off = forward(params, tokens, cfg_off, mesh=mesh)
+
+    calls = []
+    orig = fa.flash_mha_dp
+
+    def interpreted(q, k, v, **kw):
+        calls.append(1)
+        kw["interpret"] = True
+        return orig(q, k, v, **kw)
+
+    monkeypatch.setattr(fa, "flash_mha_dp", interpreted)
+    monkeypatch.setattr(tr.jax, "default_backend", lambda: "tpu")
+    cfg_auto = dataclasses.replace(cfg_off, flash_attention="auto")
+    logits_auto = forward(params, tokens, cfg_auto, mesh=mesh)
+    assert calls, "dp auto gating did not take the flash path"
+    np.testing.assert_allclose(
+        np.asarray(logits_auto), np.asarray(logits_off), atol=2e-4
+    )
+
+
+def test_pipelined_rejects_flash_on(rng):
+    """forward_pipelined must raise for flash_attention='on' (the 'on'
+    contract is kernel-or-error; stages silently pin flash off)."""
+    import dataclasses
+
+    from jax.sharding import Mesh
+
+    from flink_parameter_server_tpu.models.transformer import (
+        TransformerConfig,
+        forward_pipelined,
+        init_params,
+    )
+
+    cfg = TransformerConfig(
+        vocab_size=64, d_model=128, n_heads=2, n_layers=2, d_ff=128,
+        max_seq=128, dtype=jnp.float32, pp_axis="pp",
+        flash_attention="on",
+    )
+    params = init_params(
+        jax.random.PRNGKey(0), dataclasses.replace(cfg, flash_attention="off")
+    )
+    tokens = jnp.asarray(rng.integers(0, 64, (2, 128)), jnp.int32)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2), ("dp", "pp"))
+    with pytest.raises(ValueError, match="not supported in forward_pipelined"):
+        forward_pipelined(params, tokens, cfg, mesh=mesh)
