@@ -1,0 +1,173 @@
+"""Turn a tpu_day1 battery's raw outputs into decisions.
+
+Reads ``results/tpu/*.out``, extracts every JSON result line, and writes
+
+  * ``results/tpu/analysis.md`` — the fused-vs-unfused / packed-pallas
+    -vs-xla / flash-vs-reference tables for STATUS.md,
+  * ``results/tpu/chosen_defaults.json`` — the measured-best MF step
+    variant (scatter_impl / layout / fused / dim), which ``bench.py``
+    adopts as its TPU defaults (env knobs still win) so the end-of-round
+    driver bench runs the tuned configuration.
+
+Pure file parsing — safe to run anywhere, no JAX import.
+
+    python benchmarks/analyze_day1.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "results", "tpu")
+
+_BENCH_NAME = re.compile(r"bench_b(\d+)_([a-z0-9_]+)\.out$")
+
+
+def _json_lines(path):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{") and line.endswith("}"):
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def collect():
+    """Returns (mf_rows, config_rows).
+
+    mf_rows: list of dicts {batch, variant, value, extra} from the
+    bench sweep files; config_rows: JSON rows from baseline_configs
+    runs keyed by output file."""
+    mf = []
+    configs = []
+    if not os.path.isdir(OUT_DIR):
+        return mf, configs
+    for name in sorted(os.listdir(OUT_DIR)):
+        path = os.path.join(OUT_DIR, name)
+        m = _BENCH_NAME.search(name)
+        if m:
+            for row in _json_lines(path):
+                extra = row.get("extra", {})
+                if extra.get("platform") != "tpu":
+                    continue
+                if not isinstance(row.get("value"), (int, float)):
+                    continue
+                # schema gate: rows from code predating the
+                # dim/scatter/layout knobs are a different (stale)
+                # experiment — they must not compete for defaults
+                if not {"dim", "scatter_impl", "layout"} <= extra.keys():
+                    continue
+                mf.append({
+                    "batch": int(m.group(1)),
+                    "variant": m.group(2),
+                    "value": row["value"],
+                    "extra": extra,
+                })
+        elif name.startswith("baseline"):
+            for row in _json_lines(path):
+                if not isinstance(row.get("value"), (int, float)):
+                    continue
+                row["_source"] = name
+                configs.append(row)
+    return mf, configs
+
+
+HEADLINE_DIM = 64  # the reference-shaped MF factor width (BASELINE #1)
+
+
+def choose_defaults(mf):
+    """Best MF variant by updates/sec AMONG HEADLINE-DIM ROWS.
+
+    A dim-64 update moves half the bytes of a dim-128 one, so rates are
+    only comparable at equal dim; the headline metric is defined at the
+    reference's dim 64, so only those rows compete (d128 arms stay in
+    the table as context).  Returns None when no eligible rows exist."""
+    pool = [r for r in mf if r["extra"].get("dim") == HEADLINE_DIM]
+    if not pool:
+        return None
+    best = max(pool, key=lambda r: r["value"])
+    extra = best["extra"]
+    # Pin the batch only when the pool actually swept batch sizes — a
+    # single-batch partial window must not clamp the driver bench to a
+    # batch the static default would beat.
+    swept = len({r["batch"] for r in pool}) >= 2
+    return {
+        "source": f"bench_b{best['batch']}_{best['variant']}",
+        "updates_per_sec": best["value"],
+        "batch": best["batch"] if swept else None,
+        "scatter_impl": extra.get("scatter_impl", "xla"),
+        "layout": extra.get("layout", "dense"),
+        "fused": bool(extra.get("fused_step")),
+        "dim": extra.get("dim", HEADLINE_DIM),
+        "dtype": extra.get("table_dtype", "bfloat16"),
+    }
+
+
+def render(mf, configs, chosen):
+    lines = ["# tpu_day1 analysis", ""]
+    if mf:
+        lines += ["## MF step variants (updates/sec/chip, TPU)", "",
+                  "| batch | variant | updates/sec | bandwidth util |",
+                  "|---|---|---|---|"]
+        for r in sorted(mf, key=lambda r: (r["batch"], r["variant"])):
+            bw = r["extra"].get("bandwidth_util")
+            lines.append(
+                f"| {r['batch']} | {r['variant']} | "
+                f"{r['value']:,.0f} | {bw if bw is not None else '—'} |"
+            )
+        lines.append("")
+    if chosen:
+        lines += [
+            f"**Chosen default**: `{chosen['source']}` "
+            f"({chosen['updates_per_sec']:,.0f} updates/sec — "
+            f"scatter={chosen['scatter_impl']}, layout={chosen['layout']}, "
+            f"fused={chosen['fused']}, dim={chosen['dim']})", "",
+        ]
+    if configs:
+        lines += ["## Baseline configs", "",
+                  "| config | value | unit | source | notes |",
+                  "|---|---|---|---|---|"]
+        for row in configs:
+            extra = row.get("extra", {})
+            notes = ", ".join(
+                f"{k}={extra[k]}"
+                for k in ("scatter_impl", "layout", "flash_attention",
+                          "mfu", "seq", "batch")
+                if k in extra
+            )
+            lines.append(
+                f"| {row.get('config')} | {row['value']:,} | "
+                f"{row.get('unit')} | {row.get('_source')} | {notes} |"
+            )
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    mf, configs = collect()
+    chosen = choose_defaults(mf)
+    os.makedirs(OUT_DIR, exist_ok=True)
+    md = render(mf, configs, chosen)
+    with open(os.path.join(OUT_DIR, "analysis.md"), "w") as f:
+        f.write(md)
+    print(md)
+    if chosen:
+        with open(os.path.join(OUT_DIR, "chosen_defaults.json"), "w") as f:
+            json.dump(chosen, f, indent=1)
+        print(f"chosen_defaults -> {os.path.join(OUT_DIR, 'chosen_defaults.json')}")
+    else:
+        print("no TPU sweep rows found; defaults unchanged")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -253,16 +253,20 @@ def bench_lm():
     tpu = _is_tpu()
     # transformer-base-ish on TPU; a miniature on the 1-core CPU host.
     # FPS_LM_BATCH / FPS_LM_SEQ / FPS_LM_FLASH (auto|on|off) sweep the
-    # MFU levers (workload per step; splash-vs-reference attention).
+    # MFU levers (workload per step; splash-vs-reference attention);
+    # FPS_LM_DMODEL / FPS_LM_LAYERS / FPS_LM_HEADS / FPS_LM_DFF scale
+    # the model (MXU saturation needs wider matmuls than base-512).
     B = int(os.environ.get("FPS_LM_BATCH", 16 if tpu else 4))
     T = int(os.environ.get("FPS_LM_SEQ", 512 if tpu else 64))
     flash = os.environ.get("FPS_LM_FLASH", "auto")
+    d_model = int(os.environ.get("FPS_LM_DMODEL", 512 if tpu else 64))
     cfg = TransformerConfig(
         vocab_size=32_000 if tpu else 1_000,
-        d_model=512 if tpu else 64,
-        n_layers=6 if tpu else 2,
-        n_heads=8 if tpu else 4,
-        d_ff=2048 if tpu else 128,
+        d_model=d_model,
+        n_layers=int(os.environ.get("FPS_LM_LAYERS", 6 if tpu else 2)),
+        n_heads=int(os.environ.get("FPS_LM_HEADS", 8 if tpu else 4)),
+        d_ff=int(os.environ.get("FPS_LM_DFF",
+                                4 * d_model if tpu else 128)),
         max_seq=T,
         dtype=jnp.bfloat16 if tpu else jnp.float32,
         flash_attention=flash,
@@ -307,6 +311,7 @@ def bench_lm():
     _row(
         "5-transformer-lm-dense", tokens_per_sec, "tokens/sec",
         batch=B, seq=T, n_params=n_params,
+        d_model=cfg.d_model, n_layers=cfg.n_layers,
         mfu=round(mfu, 4) if mfu else None,
         flash_attention="on" if flash_ran else "off",
     )

@@ -165,6 +165,13 @@ def main():
                             "FPS_LM_FLASH": "auto"}),
         ("lm_t2048_noflash", {"FPS_LM_BATCH": "8", "FPS_LM_SEQ": "2048",
                               "FPS_LM_FLASH": "off"}),
+        # GPT-2-small-ish (~110M params): MXU saturation point for MFU
+        ("lm_110m", {"FPS_LM_BATCH": "8", "FPS_LM_SEQ": "1024",
+                     "FPS_LM_DMODEL": "768", "FPS_LM_LAYERS": "12",
+                     "FPS_LM_HEADS": "12"}),
+        # long-context single-chip: flash's memory win is the enabler
+        ("lm_t8192_flash", {"FPS_LM_BATCH": "1", "FPS_LM_SEQ": "8192",
+                            "FPS_LM_FLASH": "auto"}),
     ):
         env_lm = dict(os.environ)
         env_lm.update(lm_env)

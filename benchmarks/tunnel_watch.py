@@ -85,7 +85,13 @@ def main():
                 )
             except subprocess.TimeoutExpired:
                 rc2 = -1
-            log(f, f"tpu_day1 rc={rc2}; watcher done")
+            log(f, f"tpu_day1 rc={rc2}")
+            # distill the battery into decisions (pure file parsing)
+            rc3 = subprocess.call(
+                [py, os.path.join(REPO, "benchmarks", "analyze_day1.py")],
+                stdout=f, stderr=subprocess.STDOUT, cwd=REPO,
+            )
+            log(f, f"analyze_day1 rc={rc3}; watcher done")
             return 0
         log(f, "max-hours reached without a live TPU")
         return 1
