@@ -75,6 +75,20 @@ def _measured_defaults(jax) -> dict:
     if not ok:
         print(f"# ignoring malformed {path}", file=sys.stderr)
         return {}
+    # The variant knobs (fused/dim/scatter/layout) describe ONE coherent
+    # configuration — adopting them piecemeal under a partial env
+    # override can compose an invalid mix (e.g. explicit FPS_BENCH_FUSED=1
+    # with a measured dim=64), so any explicit variant knob disables the
+    # measured set wholesale.  Batch is orthogonal and keeps its own
+    # env-vs-measured resolution.
+    variant_env = [k for k in ("FPS_BENCH_FUSED", "FPS_BENCH_DIM",
+                               "FPS_BENCH_SCATTER", "FPS_BENCH_LAYOUT")
+                   if k in os.environ]
+    if variant_env:
+        print(f"# explicit {','.join(variant_env)} set: ignoring measured "
+              f"variant defaults from {path}", file=sys.stderr)
+        measured = {"batch": measured.get("batch")}
+        return measured
     print(f"# measured defaults from {path}: "
           f"batch={measured.get('batch')} "
           f"scatter={measured.get('scatter_impl')} "

@@ -96,10 +96,13 @@ def choose_defaults(mf):
         return None
     best = max(pool, key=lambda r: r["value"])
     extra = best["extra"]
-    # Pin the batch only when the pool actually swept batch sizes — a
-    # single-batch partial window must not clamp the driver bench to a
-    # batch the static default would beat.
-    swept = len({r["batch"] for r in pool}) >= 2
+    # Pin the batch only when the WINNING VARIANT was swept across batch
+    # sizes — a variant measured at a single batch (timeout-truncated
+    # battery) must not clamp the driver bench to a batch the static
+    # default would beat.
+    swept = len({
+        r["batch"] for r in pool if r["variant"] == best["variant"]
+    }) >= 2
     return {
         "source": f"bench_b{best['batch']}_{best['variant']}",
         "updates_per_sec": best["value"],
@@ -160,10 +163,17 @@ def main():
     with open(os.path.join(OUT_DIR, "analysis.md"), "w") as f:
         f.write(md)
     print(md)
+    defaults_path = os.path.join(OUT_DIR, "chosen_defaults.json")
     if chosen:
-        with open(os.path.join(OUT_DIR, "chosen_defaults.json"), "w") as f:
+        with open(defaults_path, "w") as f:
             json.dump(chosen, f, indent=1)
-        print(f"chosen_defaults -> {os.path.join(OUT_DIR, 'chosen_defaults.json')}")
+        print(f"chosen_defaults -> {defaults_path}")
+    elif os.path.exists(defaults_path):
+        # the defaults file must always reflect THIS analysis — a stale
+        # one from an earlier battery silently tuning bench.py to
+        # obsolete code is worse than no defaults
+        os.remove(defaults_path)
+        print("no eligible sweep rows; removed stale chosen_defaults.json")
     else:
         print("no TPU sweep rows found; defaults unchanged")
     return 0

@@ -70,6 +70,15 @@ class DriverConfig:
     # Periodic saves via orbax AsyncCheckpointer: save() returns after the
     # device→host copy, disk writes overlap the next training steps.
     async_checkpoints: bool = False
+    # Preemption-safe shutdown (the reference's stop-with-savepoint
+    # analogue; Flink jobs drain + savepoint on SIGTERM): on any of
+    # these signals the driver stops feeding batches, finishes the
+    # in-flight microbatches, checkpoints, and run() returns the partial
+    # result — a later resume() + run() continues from the cursor.
+    # E.g. (signal.SIGTERM,) for k8s/TPU-pod eviction.  Handlers are
+    # installed only for the duration of run() (main thread only) and
+    # the previous handlers are restored after.
+    stop_signals: tuple = ()
 
 
 class StreamingDriver:
@@ -100,6 +109,7 @@ class StreamingDriver:
         self.step_idx = 0
         self._state = None
         self._pending_skip = 0
+        self._stop_requested = False
         self._ckpt_mgr: Optional[ckpt.JobCheckpointManager] = None
         if self.config.checkpoint_dir is not None:
             self._ckpt_mgr = ckpt.JobCheckpointManager(
@@ -120,6 +130,12 @@ class StreamingDriver:
         # checkpointed (orbax otherwise silently skips duplicate steps)
         self._ckpt_mgr.save(self.step_idx, self.store, self._state, force=True)
         self._ckpt_mgr.wait()  # the explicit save() contract is durable
+
+    def request_stop(self) -> None:
+        """Programmatic preemption: the current ``run`` stops feeding
+        batches, drains in-flight microbatches, checkpoints, and returns
+        its partial result (same path as ``stop_signals``)."""
+        self._stop_requested = True
 
     def resume(self) -> bool:
         """Restore (store, worker state, step cursor) from the latest
@@ -148,6 +164,7 @@ class StreamingDriver:
         start_step = self.step_idx
         skip = self._pending_skip if fast_forward else 0
         self._pending_skip = 0
+        self._stop_requested = False  # a fresh run clears a prior stop
 
         import collections
 
@@ -155,6 +172,11 @@ class StreamingDriver:
 
         def counting(source, skipped):
             for n, b in enumerate(source):
+                if self._stop_requested:
+                    # preemption: stop feeding; the batches already in
+                    # the prefetch queue drain, then the loop closes
+                    # normally (close-time save below persists the state)
+                    return
                 if n >= skipped:  # skipped batches never reach the callback
                     if "mask" in b:
                         event_counts.append(int(np.asarray(b["mask"]).sum()))
@@ -227,6 +249,26 @@ class StreamingDriver:
                         global_step, ShardedParamStore(spec, table), state
                     )
 
+        prev_handlers = {}
+        if cfg.stop_signals:
+            import signal as _signal
+            import threading
+
+            def _request_stop(signum, frame):
+                self._stop_requested = True
+
+            if threading.current_thread() is threading.main_thread():
+                try:
+                    for s in cfg.stop_signals:
+                        prev_handlers[s] = _signal.signal(s, _request_stop)
+                except BaseException:
+                    # partial install must not leak handlers past run()
+                    for s, h in prev_handlers.items():
+                        _signal.signal(s, h)
+                    raise
+            # non-main threads can't install handlers; the flag can still
+            # be set externally via request_stop()
+
         try:
             result = transform_batched(
                 it,
@@ -247,6 +289,11 @@ class StreamingDriver:
                 self.resume()
             raise
         finally:
+            if prev_handlers:
+                import signal as _signal
+
+                for s, h in prev_handlers.items():
+                    _signal.signal(s, h)
             if trace_ctx["cm"] is not None:
                 trace_ctx["cm"].__exit__(None, None, None)
 
