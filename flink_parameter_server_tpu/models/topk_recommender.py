@@ -26,6 +26,19 @@ from .matrix_factorization import OnlineMatrixFactorization
 Array = jax.Array
 
 
+def _logical_table(spec, table: Array) -> Array:
+    """MIPS needs LOGICAL rows; unpacking a lane-packed table is a
+    reshape (+ a slice when the physical row carries pad lanes) — free
+    under jit, so serving composes with the packed training layout.
+    The unpacked view is (padded_capacity, d); ``valid_rows`` masks the
+    padding rows at the topk call sites."""
+    if spec.layout == "packed" and spec.pack > 1:
+        from ..ops.packed import unpack_table
+
+        return unpack_table(table, spec.padded_capacity, spec.row_width)
+    return table
+
+
 def query_topk(
     item_store: ShardedParamStore,
     user_vectors: Array,
@@ -43,25 +56,27 @@ def query_topk(
     spec = item_store.spec
     queries = jnp.take(user_vectors, user_ids.astype(jnp.int32), axis=0)
 
+    table = _logical_table(spec, item_store.table)
+
     if exclude is None:
         if spec.mesh is not None:
             return sharded_topk(
-                item_store.table, queries, k,
+                table, queries, k,
                 mesh=spec.mesh, ps_axis=spec.ps_axis,
                 valid_rows=spec.capacity,
             )
-        return dense_topk(item_store.table, queries, k, valid_rows=spec.capacity)
+        return dense_topk(table, queries, k, valid_rows=spec.capacity)
 
     # With exclusions: over-fetch k+E candidates then drop excluded ones.
     e = exclude.shape[1]
     if spec.mesh is not None:
         scores, ids = sharded_topk(
-            item_store.table, queries, k + e,
+            table, queries, k + e,
             mesh=spec.mesh, ps_axis=spec.ps_axis, valid_rows=spec.capacity,
         )
     else:
         scores, ids = dense_topk(
-            item_store.table, queries, k + e, valid_rows=spec.capacity
+            table, queries, k + e, valid_rows=spec.capacity
         )
     banned = (ids[:, :, None] == exclude[:, None, :]).any(-1)
     scores = jnp.where(banned, -jnp.inf, scores)
@@ -92,15 +107,16 @@ def make_mf_topk_step(logic: OnlineMatrixFactorization, spec, k: int):
             q = jnp.take(
                 new_state, batch["query_user"].astype(jnp.int32), axis=0
             )
+            serve_table = _logical_table(spec, table)
             if spec.mesh is not None:
                 scores, top_ids = sharded_topk(
-                    table, q, k,
+                    serve_table, q, k,
                     mesh=spec.mesh, ps_axis=spec.ps_axis,
                     valid_rows=spec.capacity,
                 )
             else:
                 scores, top_ids = dense_topk(
-                    table, q, k, valid_rows=spec.capacity
+                    serve_table, q, k, valid_rows=spec.capacity
                 )
             out = dict(out, topk_scores=scores, topk_ids=top_ids)
         table = store_mod.push(spec, table, req.ids, req.deltas, req.mask)

@@ -175,3 +175,35 @@ def test_make_mf_topk_step_interleaved_queries():
     np.testing.assert_allclose(
         np.asarray(out["topk_scores"]), np.asarray(want_scores), atol=1e-5
     )
+
+
+def test_query_topk_on_packed_store():
+    """Regression: serving must see LOGICAL rows — a packed item store
+    fed raw physical rows into the MIPS matmul (shape error at best,
+    wrong neighbours at worst).  Packed results must equal dense."""
+    import numpy as np
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.models.topk_recommender import query_topk
+
+    rng = np.random.default_rng(3)
+    vals = jnp.asarray(rng.normal(size=(100, 64)), jnp.float32)
+    users = jnp.asarray(rng.normal(size=(16, 64)), jnp.float32)
+    uids = jnp.arange(8, dtype=jnp.int32)
+
+    dense = ShardedParamStore.from_values(vals)
+    packed = ShardedParamStore.from_values(vals, layout="packed")
+    assert packed.spec.pack == 2  # really packed
+
+    sd, idd = query_topk(dense, users, uids, k=5)
+    sp, idp = query_topk(packed, users, uids, k=5)
+    np.testing.assert_array_equal(np.asarray(idd), np.asarray(idp))
+    np.testing.assert_allclose(
+        np.asarray(sd), np.asarray(sp), rtol=1e-5, atol=1e-6
+    )
+
+    # with exclusions, too
+    excl = jnp.asarray(np.asarray(idd[:, :2]))
+    sd2, idd2 = query_topk(dense, users, uids, k=5, exclude=excl)
+    sp2, idp2 = query_topk(packed, users, uids, k=5, exclude=excl)
+    np.testing.assert_array_equal(np.asarray(idd2), np.asarray(idp2))
