@@ -118,14 +118,21 @@ def choose_defaults(mf):
 def render(mf, configs, chosen):
     lines = ["# tpu_day1 analysis", ""]
     if mf:
-        lines += ["## MF step variants (updates/sec/chip, TPU)", "",
-                  "| batch | variant | updates/sec | bandwidth util |",
-                  "|---|---|---|---|"]
+        lines += ["## MF step variants (updates/sec/chip, TPU; "
+                  "median of reps, min–max spread)", "",
+                  "| batch | variant | updates/sec | spread | bandwidth util |",
+                  "|---|---|---|---|---|"]
         for r in sorted(mf, key=lambda r: (r["batch"], r["variant"])):
             bw = r["extra"].get("bandwidth_util")
+            lo, hi = r["extra"].get("rate_min"), r["extra"].get("rate_max")
+            spread = (
+                f"{lo:,.0f}–{hi:,.0f}" if lo is not None and hi is not None
+                else "single-shot"
+            )
             lines.append(
                 f"| {r['batch']} | {r['variant']} | "
-                f"{r['value']:,.0f} | {bw if bw is not None else '—'} |"
+                f"{r['value']:,.0f} | {spread} | "
+                f"{bw if bw is not None else '—'} |"
             )
         lines.append("")
     if chosen:

@@ -1,22 +1,29 @@
 """One-command on-chip measurement battery (run the moment a TPU is live).
 
 The dev-host tunnel has been dead since round 1; every on-chip proof
-obligation is queued behind it.  This orchestrator runs them all in
-priority order with per-job time budgets, saving raw output under
-``results/tpu/``, so even a short tunnel window yields the full evidence
-set:
+obligation is queued behind it.  This orchestrator runs them all with
+per-job time budgets, saving raw output under ``results/tpu/``, so even a
+short tunnel window yields the full evidence set.
 
-  1. bench.py batch sweep (16k / 64k / 256k, bf16)   — headline metric
-  2. microbench scatter                               — pallas-vs-XLA chunk tuning
-  3. criteo_stress (2^24-row bf16 store)              — wide-table proof
-  4. baseline_configs all                             — five-config table
-  5. MF step profiler trace                           — fused-kernel decision
+Jobs are ordered by INFORMATION PER SECOND (r2 verdict: a 3-minute
+window must settle the kernel question, not burn on bench sweeps):
+
+  1. microbench scatter + mf_fused      — the pallas-vs-XLA verdict
+  2. bench A/B arms at the decision batch (64k), then the other batches
+  3. criteo_stress (2^24-row bf16 store) — wide-table proof
+  4. baseline_configs + LM/flash arms    — five-config table, MFU levers
+  5. MF step profiler trace
+  6. analyze_day1 -> chosen_defaults.json, then ONE untuned bench.py run
+     that adopts the measured defaults and saves the official TPU
+     artifact (results/tpu/latest_bench.json) for the driver snapshot
 
     python benchmarks/tpu_day1.py [--quick]
 
 Each job runs in a SUBPROCESS with a timeout (a mid-battery tunnel death
 must not wedge the orchestrator); results and a summary land in
-results/tpu/.  Exits nonzero if the probe says no TPU.
+results/tpu/.  The summary is rewritten after EVERY job — a tunnel death
+mid-battery must not lose the record of what did run.  Exits nonzero if
+the probe says no TPU.
 """
 from __future__ import annotations
 
@@ -31,7 +38,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_DIR = os.path.join(REPO, "results", "tpu")
 
 
-def run_job(name, argv, timeout, out_dir, env=None):
+def _write_summary(results):
+    summary = os.path.join(OUT_DIR, "summary.json")
+    tmp = summary + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=1)
+    os.replace(tmp, summary)
+
+
+def run_job(name, argv, timeout, out_dir, env=None, results_acc=None):
     path = os.path.join(out_dir, f"{name}.out")
     t0 = time.time()
     status = "ok"
@@ -47,7 +62,11 @@ def run_job(name, argv, timeout, out_dir, env=None):
         status = f"timeout>{timeout}s"
     dt = round(time.time() - t0, 1)
     print(f"[{name}] {status} in {dt}s -> {path}", flush=True)
-    return {"job": name, "status": status, "secs": dt, "output": path}
+    rec = {"job": name, "status": status, "secs": dt, "output": path}
+    if results_acc is not None:
+        results_acc.append(rec)
+        _write_summary(results_acc)
+    return rec
 
 
 def main():
@@ -71,9 +90,25 @@ def main():
     py = sys.executable
     results = []
 
-    # 1. headline bench, bf16, batch sweep — three step variants:
-    #    unfused-xla (the r2 headline), pallas-packed scatter at the
-    #    native dim 64 (ops/packed.py), and the fused kernel at dim 128.
+    def job(name, argv, timeout, env=None):
+        return run_job(name, argv, timeout, OUT_DIR, env=env,
+                       results_acc=results)
+
+    # 1. the kernel verdict FIRST (highest information/second): scatter
+    #    microbench (chunk x zipf x dtype sweep) + fused MF step
+    job(
+        "microbench_scatter",
+        [py, os.path.join(REPO, "benchmarks", "microbench.py"), "scatter"],
+        int(900 * scale),
+    )
+    job(
+        "microbench_mf_fused",
+        [py, os.path.join(REPO, "benchmarks", "microbench.py"), "mf_fused"],
+        int(600 * scale),
+    )
+
+    # 2. headline bench, bf16 — the step variants A/B'd at the decision
+    #    batch (64k) first, then the other batches.
     # every variant pins ALL four knobs — an ambient FPS_BENCH_* export
     # must never silently relabel an A/B arm
     variants = (
@@ -83,6 +118,9 @@ def main():
         ("packed_pallas", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
                            "FPS_BENCH_SCATTER": "pallas",
                            "FPS_BENCH_LAYOUT": "packed"}),
+        ("packed_xla", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                        "FPS_BENCH_SCATTER": "xla",
+                        "FPS_BENCH_LAYOUT": "packed"}),
         ("fused_d128", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "128",
                         "FPS_BENCH_SCATTER": "xla",
                         "FPS_BENCH_LAYOUT": "dense"}),
@@ -90,47 +128,26 @@ def main():
                               "FPS_BENCH_SCATTER": "xla",
                               "FPS_BENCH_LAYOUT": "packed"}),
     )
-    for batch in (16_384, 65_536, 262_144):
+    for batch in (65_536, 16_384, 262_144):
         for tag, extra_env in variants:
             env = dict(os.environ)
             env["FPS_BENCH_BATCH"] = str(batch)
             env["FPS_BENCH_DTYPE"] = "bfloat16"
             env.update(extra_env)
-            results.append(
-                run_job(
-                    f"bench_b{batch}_{tag}",
-                    [py, os.path.join(REPO, "bench.py")],
-                    int(600 * scale), OUT_DIR, env=env,
-                )
+            job(
+                f"bench_b{batch}_{tag}",
+                [py, os.path.join(REPO, "bench.py")],
+                int(600 * scale), env=env,
             )
         if args.quick:
-            break  # one batch size is enough for a short window
-
-    # 2. scatter microbench (chunk x zipf x dtype sweep) + fused MF step
-    results.append(
-        run_job(
-            "microbench_scatter",
-            [py, os.path.join(REPO, "benchmarks", "microbench.py"), "scatter"],
-            int(900 * scale), OUT_DIR,
-        )
-    )
-    results.append(
-        run_job(
-            "microbench_mf_fused",
-            [py, os.path.join(REPO, "benchmarks", "microbench.py"),
-             "mf_fused"],
-            int(600 * scale), OUT_DIR,
-        )
-    )
+            break  # the decision batch is enough for a short window
 
     # 3. Criteo-scale stress (>=10M-row bf16 store, pallas scatter)
-    results.append(
-        run_job(
-            "criteo_stress",
-            [py, os.path.join(REPO, "benchmarks", "criteo_stress.py")]
-            + (["--rows", "4194304"] if args.quick else []),
-            int(900 * scale), OUT_DIR,
-        )
+    job(
+        "criteo_stress",
+        [py, os.path.join(REPO, "benchmarks", "criteo_stress.py")]
+        + (["--rows", "4194304"] if args.quick else []),
+        int(900 * scale),
     )
 
     # 4. all five baseline configs — default (xla/dense) arm, then the
@@ -138,23 +155,18 @@ def main():
     # defaults hang on; every knob pinned per arm)
     env_a = dict(os.environ)
     env_a.update({"FPS_CFG_SCATTER": "xla", "FPS_CFG_LAYOUT": "dense"})
-    results.append(
-        run_job(
-            "baseline_configs",
-            [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
-             "all"],
-            int(1200 * scale), OUT_DIR, env=env_a,
-        )
+    job(
+        "baseline_configs",
+        [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"), "all"],
+        int(1200 * scale), env=env_a,
     )
     env_b = dict(os.environ)
     env_b.update({"FPS_CFG_SCATTER": "pallas", "FPS_CFG_LAYOUT": "packed"})
-    results.append(
-        run_job(
-            "baseline_configs_packed_pallas",
-            [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
-             "pa", "w2v", "fm"],
-            int(900 * scale), OUT_DIR, env=env_b,
-        )
+    job(
+        "baseline_configs_packed_pallas",
+        [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
+         "pa", "w2v", "fm"],
+        int(900 * scale), env=env_b,
     )
 
     # 4b. transformer-LM MFU levers: bigger per-step workload, and the
@@ -175,42 +187,58 @@ def main():
     ):
         env_lm = dict(os.environ)
         env_lm.update(lm_env)
-        results.append(
-            run_job(
-                f"baseline_{tag}",
-                [py, os.path.join(REPO, "benchmarks",
-                                  "baseline_configs.py"), "lm"],
-                int(600 * scale), OUT_DIR, env=env_lm,
-            )
+        job(
+            f"baseline_{tag}",
+            [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
+             "lm"],
+            int(600 * scale), env=env_lm,
         )
 
     # 5. profiler trace of the MF step (the fused-kernel decision input).
     # One untraced call first: same shapes -> the jit cache is warm, so
     # the trace captures steady-state steps, not compilation
     # (tracing.profile_trace's own guidance).
-    results.append(
-        run_job(
-            "mf_profile",
-            [py, "-c", (
-                "import sys; sys.path.insert(0, %r)\n"
-                "import os\n"
-                "import jax\n"
-                "from flink_parameter_server_tpu.training import tracing\n"
-                "import bench\n"
-                "os.environ['FPS_BENCH_BATCH'] = '65536'\n"
-                "bench.tpu_updates_per_sec(bench_steps=2)  # compile+warm\n"
-                "with tracing.profile_trace(%r):\n"
-                "    bench.tpu_updates_per_sec(warmup_steps=1, bench_steps=10)\n"
-                "print('trace saved')\n"
-            ) % (REPO, os.path.join(OUT_DIR, "mf_trace"))],
-            int(600 * scale), OUT_DIR,
-        )
+    job(
+        "mf_profile",
+        [py, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import os\n"
+            "import jax\n"
+            "from flink_parameter_server_tpu.training import tracing\n"
+            "import bench\n"
+            "os.environ['FPS_BENCH_BATCH'] = '65536'\n"
+            "bench.tpu_updates_per_sec(bench_steps=2)  # compile+warm\n"
+            "with tracing.profile_trace(%r):\n"
+            "    bench.tpu_updates_per_sec(warmup_steps=1, bench_steps=10)\n"
+            "print('trace saved')\n"
+        ) % (REPO, os.path.join(OUT_DIR, "mf_trace"))],
+        int(600 * scale),
     )
 
-    summary = os.path.join(OUT_DIR, "summary.json")
-    with open(summary, "w") as f:
-        json.dump(results, f, indent=1)
-    print(f"summary -> {summary}")
+    # 6. distill the battery into chosen_defaults.json, then one UNTUNED
+    #    bench run that adopts the measured defaults — its saved artifact
+    #    (results/tpu/latest_bench.json) is what the driver's end-of-round
+    #    snapshot reports if the tunnel is dead by then.
+    job(
+        "analyze_day1",
+        [py, os.path.join(REPO, "benchmarks", "analyze_day1.py")],
+        300,
+    )
+    # strip only the variant/batch/dtype PINS — robustness knobs like
+    # FPS_BENCH_INIT_TIMEOUT / FPS_BENCH_REPS are not tuning state and
+    # must survive into the final run
+    pins = {
+        "FPS_BENCH_FUSED", "FPS_BENCH_DIM", "FPS_BENCH_SCATTER",
+        "FPS_BENCH_LAYOUT", "FPS_BENCH_BATCH", "FPS_BENCH_DTYPE",
+        "FPS_BENCH_FUSED_CHUNK",
+    }
+    env_final = {k: v for k, v in os.environ.items() if k not in pins}
+    job(
+        "bench_final_tuned",
+        [py, os.path.join(REPO, "bench.py")],
+        int(600 * scale), env=env_final,
+    )
+    print(f"summary -> {os.path.join(OUT_DIR, 'summary.json')}")
     return 0
 
 

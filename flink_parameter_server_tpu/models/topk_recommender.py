@@ -31,8 +31,13 @@ def _logical_table(spec, table: Array) -> Array:
     reshape (+ a slice when the physical row carries pad lanes) — free
     under jit, so serving composes with the packed training layout.
     The unpacked view is (padded_capacity, d); ``valid_rows`` masks the
-    padding rows at the topk call sites."""
-    if spec.layout == "packed" and spec.pack > 1:
+    padding rows at the topk call sites.
+
+    Gate on the layout alone: even at pack == 1 (row width 65-127) the
+    physical rows are lane-PADDED to width 128, so the raw table would
+    shape-mismatch ``queries @ table.T`` — ``unpack_table`` handles
+    pack == 1 by slicing off the pad lanes."""
+    if spec.layout == "packed":
         from ..ops.packed import unpack_table
 
         return unpack_table(table, spec.padded_capacity, spec.row_width)

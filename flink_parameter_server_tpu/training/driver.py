@@ -264,7 +264,11 @@ class StreamingDriver:
                 except BaseException:
                     # partial install must not leak handlers past run()
                     for s, h in prev_handlers.items():
-                        _signal.signal(s, h)
+                        # None = prior handler installed from C (see the
+                        # restore in the finally block below)
+                        _signal.signal(
+                            s, _signal.SIG_DFL if h is None else h
+                        )
                     raise
             # non-main threads can't install handlers; the flag can still
             # be set externally via request_stop()
@@ -293,7 +297,13 @@ class StreamingDriver:
                 import signal as _signal
 
                 for s, h in prev_handlers.items():
-                    _signal.signal(s, h)
+                    # A prior handler installed from C reads back as
+                    # None; signal.signal(s, None) raises TypeError and
+                    # would crash a successful run at exit.  SIG_DFL is
+                    # the closest restorable state (the C handler itself
+                    # is unrecoverable from Python) and avoids leaking
+                    # _request_stop — a closure over self — past run().
+                    _signal.signal(s, _signal.SIG_DFL if h is None else h)
             if trace_ctx["cm"] is not None:
                 trace_ctx["cm"].__exit__(None, None, None)
 

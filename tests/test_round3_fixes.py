@@ -1,0 +1,199 @@
+"""Round-3 regression tests: the advisor findings (ADVICE.md r2) stay fixed.
+
+Covers:
+  * topk serving unpacks ANY packed store — including pack == 1 widths
+    (65-127), whose physical rows are lane-padded to 128 and would
+    shape-mismatch ``queries @ table.T`` raw.
+  * bench._measured_defaults drops an incoherent measured set
+    (fused=true, dim % 128 != 0, layout not packed-resolving) instead of
+    later aborting with a SystemExit blaming an unset env var.
+  * StreamingDriver.run() restores signal handlers safely when the prior
+    handler was installed from C (signal.getsignal() -> None).
+"""
+import json
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.models.topk_recommender import (
+    make_mf_topk_step,
+    query_topk,
+)
+from flink_parameter_server_tpu.utils.initializers import normal_factor
+
+
+@pytest.mark.parametrize("width", [100, 64, 17])
+def test_query_topk_packed_any_width(width):
+    """Packed stores must serve top-k at every width class: pack == 1
+    lane-padded (100), pack > 1 (64, 17)."""
+    cap = 50
+    store = ShardedParamStore.create(
+        cap, (width,), dtype=jnp.float32,
+        init_fn=normal_factor(0, (width,)), layout="packed",
+    )
+    dense = ShardedParamStore.from_values(store.values())  # dense oracle
+    q_users = jnp.asarray(np.random.default_rng(0).normal(size=(4, width)),
+                          jnp.float32)
+    uids = jnp.arange(4, dtype=jnp.int32)
+    s_packed, i_packed = query_topk(store, q_users, uids, k=5)
+    s_dense, i_dense = query_topk(dense, q_users, uids, k=5)
+    np.testing.assert_allclose(
+        np.asarray(s_packed), np.asarray(s_dense), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(i_packed), np.asarray(i_dense))
+
+
+def test_mf_topk_step_packed_pack1_width():
+    """The fused train+serve step on a pack==1 packed store (the exact
+    ADVICE r2 repro: width-100 store -> dot_general shape mismatch)."""
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+
+    width, cap, users, b = 100, 40, 8, 16
+    store = ShardedParamStore.create(
+        cap, (width,), dtype=jnp.float32,
+        init_fn=normal_factor(0, (width,)), layout="packed",
+    )
+    logic = OnlineMatrixFactorization(users, width, updater=SGDUpdater(0.01))
+    state = logic.init_state(jax.random.PRNGKey(0))
+    step = jax.jit(make_mf_topk_step(logic, store.spec, k=3))
+    rng = np.random.default_rng(1)
+    batch = {
+        "user": jnp.asarray(rng.integers(0, users, b), jnp.int32),
+        "item": jnp.asarray(rng.integers(0, cap, b), jnp.int32),
+        "rating": jnp.asarray(rng.normal(size=b), jnp.float32),
+        "mask": jnp.ones(b, bool),
+        "query_user": jnp.arange(4, dtype=jnp.int32),
+    }
+    table, state, out = step(store.table, state, batch)
+    assert out["topk_ids"].shape == (4, 3)
+    assert np.isfinite(np.asarray(out["topk_scores"])).all()
+
+
+class _FakeTpuJax:
+    @staticmethod
+    def default_backend():
+        return "tpu"
+
+
+def _write_defaults(tmp_path, payload):
+    p = tmp_path / "chosen_defaults.json"
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_measured_defaults_rejects_incoherent_fused_set(tmp_path, capsys):
+    import bench
+
+    path = _write_defaults(tmp_path, {
+        "scatter_impl": "xla", "layout": "dense",
+        "fused": True, "dim": 64, "batch": 16384,
+    })
+    out = bench._measured_defaults(_FakeTpuJax, path=path)
+    assert out == {}
+    assert "incoherent" in capsys.readouterr().err
+
+
+def test_measured_defaults_keeps_coherent_fused_sets(tmp_path):
+    import bench
+
+    for payload in (
+        {"scatter_impl": "xla", "layout": "dense", "fused": True,
+         "dim": 128, "batch": 16384},
+        {"scatter_impl": "pallas", "layout": "packed", "fused": True,
+         "dim": 64, "batch": 16384},
+        {"scatter_impl": "xla", "layout": "dense", "fused": False,
+         "dim": 64, "batch": 16384},
+    ):
+        path = _write_defaults(tmp_path, payload)
+        out = bench._measured_defaults(_FakeTpuJax, path=path)
+        assert out == payload, payload
+
+
+def test_tpu_artifact_pinned_and_recency_gates(tmp_path, monkeypatch):
+    """Pinned A/B arms must never adopt/save the official TPU artifact
+    (a dead-tunnel battery arm echoing the last arm's payload would
+    corrupt the filename-keyed analysis), and stale artifacts from a
+    previous round must not masquerade as current."""
+    import time as _time
+
+    import bench
+
+    payload = {"metric": "m", "value": 1.0, "unit": "u",
+               "extra": {"platform": "tpu"}}
+    art_path = tmp_path / "latest_bench.json"
+    monkeypatch.setattr(bench, "_TPU_ARTIFACT", str(art_path))
+
+    for k in bench._PIN_KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    assert not bench._is_pinned()
+    monkeypatch.setenv("FPS_BENCH_BATCH", "16384")
+    assert bench._is_pinned()
+    monkeypatch.delenv("FPS_BENCH_BATCH")
+
+    bench._save_tpu_artifact(payload)
+    art = bench._load_recent_tpu_artifact()
+    assert art is not None and art["payload"]["value"] == 1.0
+
+    # stale (older than the recency gate) -> rejected
+    stale = {"captured_at": _time.time() - 48 * 3600, "payload": payload}
+    art_path.write_text(json.dumps(stale))
+    assert bench._load_recent_tpu_artifact() is None
+
+    # cpu-platform payload -> rejected even if fresh
+    cpu_payload = {"metric": "m", "value": 1.0, "unit": "u",
+                   "extra": {"platform": "cpu"}}
+    art_path.write_text(json.dumps(
+        {"captured_at": _time.time(), "payload": cpu_payload}
+    ))
+    assert bench._load_recent_tpu_artifact() is None
+
+
+def test_driver_restores_none_signal_handler(monkeypatch):
+    """A prior C-installed handler reads back as None; run() must not
+    crash restoring it (TypeError at exit of a successful run)."""
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.training.driver import (
+        DriverConfig,
+        StreamingDriver,
+    )
+
+    sig = signal.SIGUSR2
+    orig = signal.getsignal(sig)
+    real_signal = signal.signal
+
+    def fake_signal(s, h):
+        r = real_signal(s, h)
+        # emulate a C-installed prior handler on first install
+        return None if s == sig and h is not orig else r
+
+    monkeypatch.setattr(signal, "signal", fake_signal)
+    store = ShardedParamStore.create(
+        16, (8,), dtype=jnp.float32, init_fn=normal_factor(0, (8,)),
+    )
+    logic = OnlineMatrixFactorization(4, 8, updater=SGDUpdater(0.01))
+    driver = StreamingDriver(
+        logic, store, config=DriverConfig(stop_signals=(sig,)),
+    )
+    rng = np.random.default_rng(0)
+    b = 8
+    batches = [{
+        "user": jnp.asarray(rng.integers(0, 4, b), jnp.int32),
+        "item": jnp.asarray(rng.integers(0, 16, b), jnp.int32),
+        "rating": jnp.asarray(rng.normal(size=b), jnp.float32),
+        "mask": jnp.ones(b, bool),
+    }]
+    driver.run(batches)  # must not raise TypeError in the finally block
+    # the unrecoverable C handler is mapped to SIG_DFL, not left as the
+    # driver's _request_stop closure
+    assert signal.getsignal(sig) == signal.SIG_DFL
+    real_signal(sig, orig)
