@@ -208,6 +208,17 @@ def tpu_updates_per_sec(
         raise SystemExit(f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas")
     if layout not in ("dense", "packed", "auto"):
         raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
+    # validated up front with the other knobs: a typo must exit in
+    # milliseconds, not after burning a tunnel window on compile+warmup
+    raw_reps = os.environ.get("FPS_BENCH_REPS", "3")
+    try:
+        reps = int(raw_reps)
+    except ValueError:
+        raise SystemExit(
+            f"FPS_BENCH_REPS={raw_reps!r}: expected a positive integer"
+        ) from None
+    if reps <= 0:
+        raise SystemExit(f"FPS_BENCH_REPS={reps}: must be positive")
     from flink_parameter_server_tpu.core.store import _resolve_layout
 
     _resolves_packed = _resolve_layout(layout, "add", (dim,)) == "packed"
@@ -321,15 +332,6 @@ def tpu_updates_per_sec(
     # throughput: free-running (pipelined) steps, >=3 reps — short tunnel
     # windows showed 80% window-to-window swings (r2 verdict), so a
     # single-shot number is not evidence; report the median + spread.
-    raw_reps = os.environ.get("FPS_BENCH_REPS", "3")
-    try:
-        reps = int(raw_reps)
-    except ValueError:
-        raise SystemExit(
-            f"FPS_BENCH_REPS={raw_reps!r}: expected a positive integer"
-        ) from None
-    if reps <= 0:
-        raise SystemExit(f"FPS_BENCH_REPS={reps}: must be positive")
     rep_rates = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -480,17 +482,28 @@ def _load_recent_tpu_artifact():
     that number beats reporting a CPU fallback — the driver's BENCH_rN
     capture happens whenever the round ends, not when the chip was up.
     Recency-gated so a stale artifact from a previous round can't
-    masquerade as current (default 24 h, env-overridable)."""
+    masquerade as current (default 24 h, env-overridable).  Only a
+    malformed FILE degrades silently to the fallback path; a malformed
+    explicit env value aborts (same rule as the other knobs)."""
+    raw_age = os.environ.get("FPS_BENCH_TPU_ARTIFACT_MAX_AGE_H", "24")
+    try:
+        max_age_h = float(raw_age)
+    except ValueError:
+        raise SystemExit(
+            f"FPS_BENCH_TPU_ARTIFACT_MAX_AGE_H={raw_age!r}: expected a "
+            f"number of hours"
+        ) from None
     try:
         with open(_TPU_ARTIFACT) as f:
             art = json.load(f)
         captured = float(art["captured_at"])
         payload = art["payload"]
-        max_age_h = float(os.environ.get("FPS_BENCH_TPU_ARTIFACT_MAX_AGE_H",
-                                         "24"))
+        if not isinstance(payload, dict) or "metric" not in payload:
+            return None
         if time.time() - captured > max_age_h * 3600:
             return None
-        if payload.get("extra", {}).get("platform") != "tpu":
+        extra = payload.get("extra")
+        if not isinstance(extra, dict) or extra.get("platform") != "tpu":
             return None
         return art
     except (OSError, ValueError, KeyError, TypeError):

@@ -151,7 +151,7 @@ def render(mf, configs, chosen):
             notes = ", ".join(
                 f"{k}={extra[k]}"
                 for k in ("scatter_impl", "layout", "flash_attention",
-                          "mfu", "seq", "batch")
+                          "mfu", "seq", "batch", "bandwidth_util")
                 if k in extra
             )
             lines.append(

@@ -226,13 +226,14 @@ def main():
     )
     # strip only the variant/batch/dtype PINS — robustness knobs like
     # FPS_BENCH_INIT_TIMEOUT / FPS_BENCH_REPS are not tuning state and
-    # must survive into the final run
-    pins = {
-        "FPS_BENCH_FUSED", "FPS_BENCH_DIM", "FPS_BENCH_SCATTER",
-        "FPS_BENCH_LAYOUT", "FPS_BENCH_BATCH", "FPS_BENCH_DTYPE",
-        "FPS_BENCH_FUSED_CHUNK",
+    # must survive into the final run.  The pin set is bench.py's own
+    # (one source of truth: a knob added there must flip _is_pinned()
+    # AND be stripped here, or the final run never saves the artifact).
+    import bench
+
+    env_final = {
+        k: v for k, v in os.environ.items() if k not in bench._PIN_KNOBS
     }
-    env_final = {k: v for k, v in os.environ.items() if k not in pins}
     job(
         "bench_final_tuned",
         [py, os.path.join(REPO, "bench.py")],
