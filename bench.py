@@ -64,7 +64,8 @@ def _measured_defaults(jax, path=None) -> dict:
     # dropped with a warning, not die blaming an env var nobody set.
     ok = (
         isinstance(measured, dict)
-        and measured.get("scatter_impl", "xla") in ("xla", "pallas")
+        and measured.get("scatter_impl", "xla") in ("xla", "pallas",
+                                                    "xla_sorted")
         and measured.get("layout", "dense") in ("dense", "packed", "auto")
         and (measured.get("batch") is None
              or (isinstance(measured.get("batch"), int)
@@ -204,8 +205,10 @@ def tpu_updates_per_sec(
     layout = os.environ.get(
         "FPS_BENCH_LAYOUT", measured.get("layout", "dense")
     )
-    if scatter_impl not in ("xla", "pallas"):
-        raise SystemExit(f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas")
+    if scatter_impl not in ("xla", "pallas", "xla_sorted"):
+        raise SystemExit(
+            f"FPS_BENCH_SCATTER={scatter_impl!r}: xla|pallas|xla_sorted"
+        )
     if layout not in ("dense", "packed", "auto"):
         raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
     # validated up front with the other knobs: a typo must exit in
@@ -371,6 +374,20 @@ def tpu_updates_per_sec(
         hbm_bytes_per_step = (
             (3 * batch + 2 * unique_items) * row_lanes * el  # rows
             + 8 * batch * 4  # id sort/permute passes (int32)
+        )
+    elif scatter_impl == "xla_sorted":
+        # item side: B-row gather + B-row delta permute (read+write —
+        # jnp.take(deltas, order) materializes in HBM) + UNIQUE-row
+        # scatter RMW + id sort passes; user side unchanged (3 B-row
+        # traversals).  For the packed layout dedup runs at PHYSICAL
+        # granularity (store.push), so count unique physical rows.
+        if store.spec.layout == "packed":
+            uniq = len(np.unique(items // store.spec.pack))
+        else:
+            uniq = unique_items
+        hbm_bytes_per_step = (
+            (3 * batch + 3 * batch + 2 * uniq) * row_lanes * el
+            + 8 * batch * 4
         )
     else:
         hbm_bytes_per_step = 6 * batch * row_lanes * el

@@ -48,10 +48,12 @@ def _store_opts() -> dict:
     pallas is downgraded off-TPU (interpret mode is not a perf path)."""
     scatter = os.environ.get("FPS_CFG_SCATTER", "xla")
     layout = os.environ.get("FPS_CFG_LAYOUT", "dense")
-    if scatter not in ("xla", "pallas"):
+    if scatter not in ("xla", "pallas", "xla_sorted"):
         # a typo would silently benchmark XLA while the JSON row records
         # the typo as the pallas arm (bench.py has the same validation)
-        raise SystemExit(f"FPS_CFG_SCATTER={scatter!r}: xla|pallas")
+        raise SystemExit(
+            f"FPS_CFG_SCATTER={scatter!r}: xla|pallas|xla_sorted"
+        )
     if layout not in ("dense", "packed", "auto"):
         raise SystemExit(f"FPS_CFG_LAYOUT={layout!r}: dense|packed|auto")
     if scatter == "pallas" and not _is_tpu():

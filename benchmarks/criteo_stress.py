@@ -37,7 +37,8 @@ def main():
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--zipf", type=float, default=1.2)
     ap.add_argument(
-        "--scatter", default="pallas", choices=["pallas", "xla"]
+        "--scatter", default="pallas",
+        choices=["pallas", "xla", "xla_sorted"],
     )
     ap.add_argument(
         "--layout", default="packed", choices=["packed", "dense"],

@@ -121,6 +121,12 @@ def main():
         ("packed_xla", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
                         "FPS_BENCH_SCATTER": "xla",
                         "FPS_BENCH_LAYOUT": "packed"}),
+        ("sorted_xla", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                        "FPS_BENCH_SCATTER": "xla_sorted",
+                        "FPS_BENCH_LAYOUT": "dense"}),
+        ("packed_sorted", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                           "FPS_BENCH_SCATTER": "xla_sorted",
+                           "FPS_BENCH_LAYOUT": "packed"}),
         ("fused_d128", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "128",
                         "FPS_BENCH_SCATTER": "xla",
                         "FPS_BENCH_LAYOUT": "dense"}),

@@ -44,10 +44,12 @@ Array = jax.Array
 InitFn = Callable[[Array], Array]  # ids (n,) int32 -> values (n, *value_shape)
 UpdateFn = Callable[[Array, Array], Array]  # (current, combined_delta) -> new
 
-# Trace-time count of pushes where a scatter_impl="pallas" store had to
-# fall back to the XLA scatter (batch not divisible by dp).  The choice is
-# static per compiled step, so one warning per offending trace suffices —
-# a user who configured pallas must never *silently* not get it.
+# Trace-time count of pushes where a non-default scatter_impl ("pallas",
+# "xla_sorted") had to fall back to the XLA scatter (batch not divisible
+# by dp, Mosaic shape violation).  The choice is static per compiled
+# step, so one warning per offending trace suffices — a user who
+# configured a specific impl must never *silently* not get it (a bench
+# row would then mislabel which arm actually ran).
 _PALLAS_FALLBACKS = 0
 
 
@@ -55,14 +57,32 @@ def pallas_fallback_count() -> int:
     return _PALLAS_FALLBACKS
 
 
-def _note_pallas_fallback(reason: str) -> None:
+def _note_scatter_fallback(impl: str, reason: str) -> None:
     global _PALLAS_FALLBACKS
     _PALLAS_FALLBACKS += 1
     warnings.warn(
-        f"scatter_impl='pallas' store falling back to XLA scatter: {reason}",
+        f"scatter_impl={impl!r} store falling back to XLA scatter: "
+        f"{reason}",
         RuntimeWarning,
         stacklevel=3,
     )
+
+
+def _note_pallas_fallback(reason: str) -> None:
+    _note_scatter_fallback("pallas", reason)
+
+
+def _dp_axis_and_divisible(mesh, n: int):
+    """(dp_axis or None, batch-divisibility ok) — the shared gate for
+    dispatching a push through shard_push_add's all_gather plane."""
+    from ..parallel.mesh import DP_AXIS
+
+    dp_axis = (
+        DP_AXIS
+        if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
+        else None
+    )
+    return dp_axis, (dp_axis is None or n % mesh.shape[dp_axis] == 0)
 
 
 def _resolve_layout(
@@ -105,6 +125,9 @@ class StoreSpec:
     # "xla" = native XLA scatter; "pallas" = the sorted-run duplicate
     # -compressing TPU kernel (ops/pallas_scatter.py) — wins under Zipf-hot
     # id distributions; only valid with update="add" and vector values.
+    # "xla_sorted" = duplicate compression in pure XLA (sort + segment-sum
+    # + unique_indices scatter, ops/sorted_scatter.py) — no Mosaic shape
+    # constraints, runs on any backend; only valid with update="add".
     scatter_impl: str = "xla"
     mesh: Optional[Mesh] = None
     ps_axis: str = "ps"
@@ -354,19 +377,13 @@ def push(
                 # batch length to divide the dp size for the all_gather
                 # specs; otherwise fall back to XLA scatter.
                 from ..parallel.collectives import shard_push_add
-                from ..parallel.mesh import DP_AXIS
 
                 s_ids, s_deltas = _phys_scatter_args(
                     spec, table, flat_ids, flat_deltas
                 )
-                mesh = spec.mesh
-                dp_axis = (
-                    DP_AXIS
-                    if DP_AXIS in mesh.axis_names and mesh.shape[DP_AXIS] > 1
-                    else None
-                )
                 n = s_ids.shape[0]
-                if dp_axis is None or n % mesh.shape[dp_axis] == 0:
+                dp_axis, divisible = _dp_axis_and_divisible(spec.mesh, n)
+                if divisible:
                     # mask=None: masked lanes' deltas were zeroed above,
                     # so a no-op under add — skip the extra mask all_gather
                     return shard_push_add(
@@ -374,17 +391,45 @@ def push(
                         s_ids,
                         s_deltas,
                         None,
-                        mesh=mesh,
+                        mesh=spec.mesh,
                         ps_axis=spec.ps_axis,
                         dp_axis=dp_axis,
                         impl="pallas",
                     )
                 _note_pallas_fallback(
-                    f"flat batch {n} not divisible by dp={mesh.shape[dp_axis]}"
+                    f"flat batch {n} not divisible by "
+                    f"dp={spec.mesh.shape[dp_axis]}"
                 )
         s_ids, s_deltas = _phys_scatter_args(
             spec, table, flat_ids, flat_deltas
         )
+        if spec.scatter_impl == "xla_sorted":
+            # duplicate compression in pure XLA (ops/sorted_scatter.py):
+            # for the packed layout this runs at PHYSICAL granularity, so
+            # Zipf-hot neighbours sharing a physical row combine too
+            if spec.num_shards == 1:
+                from ..ops.sorted_scatter import sorted_dedup_scatter_add
+
+                return sorted_dedup_scatter_add(
+                    table, s_ids, s_deltas, None,
+                    oob=table.shape[0],
+                )
+            from ..parallel.collectives import shard_push_add
+
+            n = s_ids.shape[0]
+            dp_axis, divisible = _dp_axis_and_divisible(spec.mesh, n)
+            if divisible:
+                return shard_push_add(
+                    table, s_ids, s_deltas, None,
+                    mesh=spec.mesh, ps_axis=spec.ps_axis, dp_axis=dp_axis,
+                    impl="xla_sorted",
+                )
+            # plain XLA scatter is still correct — but never silent
+            _note_scatter_fallback(
+                "xla_sorted",
+                f"flat batch {n} not divisible by "
+                f"dp={spec.mesh.shape[dp_axis]}",
+            )
         return table.at[s_ids].add(
             s_deltas.astype(table.dtype), mode="drop"
         )

@@ -1,0 +1,91 @@
+"""Duplicate-compressing scatter-add in pure XLA: sort → segment-sum →
+one scatter per UNIQUE row, declared ``unique_indices=True``.
+
+Reference parity (SURVEY.md §7 "Hard parts"): the reference's servers
+fold each push message into a JVM hash map — duplicate keys cost one map
+update each, cheap on a CPU.  On TPU, XLA lowers ``table.at[ids].add``
+with duplicate indices to a serialized read-modify-write chain per
+conflicting row: a Zipf-hot batch (the recommender workload) can send
+hundreds of lanes at the SAME hot row, and the scatter's critical path
+becomes the hottest row's duplicate count.  That serialization — not
+bytes moved — is why the r2 trace shows the scatter fusion at ~3% of
+HBM peak.
+
+This module removes the duplicates *before* the scatter, entirely in
+XLA (no Mosaic shape constraints, any dtype/width/backend):
+
+  1. ``argsort`` the flat ids (TPU sort is fast — 1.3% of the r2 step),
+  2. segment-sum runs of equal ids (``indices_are_sorted=True``),
+  3. scatter the per-unique sums at the first-occurrence rows with
+     ``unique_indices=True`` — XLA may now vectorize the RMW freely,
+     no conflict serialization.
+
+Empty slots (batch had fewer unique ids than lanes) are routed to
+DISTINCT out-of-bounds ids: ``mode="drop"`` discards them, and
+distinctness keeps the ``unique_indices`` promise honest — a shared
+sentinel would be a lie XLA is allowed to miscompile.
+
+This is the third ``scatter_impl`` arm ("xla_sorted"), between plain
+"xla" and the Pallas kernel: same sorted-window idea as
+:mod:`.pallas_scatter`, but letting XLA schedule the memory traffic.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def sorted_dedup_scatter_add(
+    table: Array,
+    ids: Array,
+    deltas: Array,
+    mask: Optional[Array] = None,
+    *,
+    oob: Optional[int] = None,
+) -> Array:
+    """``table.at[ids].add(deltas)`` with duplicates pre-combined.
+
+    ``ids``: (n,) int32, out-of-range values (>= table rows, or >= oob)
+    are dropped.  ``deltas``: (n, *value_shape).  ``mask``: optional (n,)
+    bool — masked lanes are dropped (their ids are routed out of bounds,
+    so they cannot even contribute a zero-add to a hot row's segment).
+    """
+    rows = table.shape[0]
+    if oob is None:
+        oob = rows
+    n = ids.shape[0]
+    ids = ids.astype(jnp.int32)
+    if mask is not None:
+        ids = jnp.where(mask, ids, oob)
+    # Route negatives (would wrap before mode="drop") AND any id beyond
+    # ``oob`` to exactly ``oob``: sorted ids then never exceed ``oob``,
+    # so the empty-slot reps ``oob + slot`` (slot >= 1) cannot collide
+    # with a real segment's rep — the unique_indices promise holds for
+    # arbitrary caller ids.
+    ids = jnp.where((ids < 0) | (ids > oob), oob, ids)
+
+    order = jnp.argsort(ids)
+    sid = jnp.take(ids, order)
+    sdl = jnp.take(deltas, order, axis=0)
+
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sid[1:] != sid[:-1]]
+    )
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1  # (n,) 0-based segment
+    sums = jax.ops.segment_sum(
+        sdl, seg, num_segments=n, indices_are_sorted=True
+    )
+    # representative id per segment slot; empty slots get DISTINCT
+    # out-of-bounds ids (see module docstring)
+    rep = oob + jnp.arange(n, dtype=jnp.int32)
+    rep = rep.at[seg].set(sid)  # duplicate writers carry equal values
+    return table.at[rep].add(
+        sums.astype(table.dtype), mode="drop", unique_indices=True
+    )
+
+
+__all__ = ["sorted_dedup_scatter_add"]

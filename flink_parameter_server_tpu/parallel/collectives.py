@@ -106,6 +106,8 @@ def shard_push_add(
     ``impl="pallas"``: each shard's local scatter runs the sorted-run
     duplicate-compressing kernel (:mod:`..ops.pallas_scatter`) — one HBM
     read-modify-write per unique local row under Zipf-hot ids.
+    ``impl="xla_sorted"``: the same dedup in pure XLA
+    (:mod:`..ops.sorted_scatter`) — no Mosaic shape constraints.
     """
     value_rank = table.ndim - 1
     if impl == "pallas":
@@ -159,6 +161,16 @@ def shard_push_add(
                 rel,
                 local_deltas.reshape((-1,) + local_table.shape[1:]),
                 hit,
+            )
+        if impl == "xla_sorted":
+            from ..ops.sorted_scatter import sorted_dedup_scatter_add
+
+            return sorted_dedup_scatter_add(
+                local_table,
+                rel,
+                local_deltas.reshape((-1,) + local_table.shape[1:]),
+                hit,
+                oob=rows,
             )
         rel = jnp.clip(rel, 0, rows - 1)
         d = local_deltas.reshape((-1,) + local_table.shape[1:])
