@@ -272,8 +272,13 @@ def tpu_updates_per_sec(
         scatter_impl = "xla"
 
     # lr matches cpu_per_record_baseline (both sides numerically stable).
+    # The sorted arm applies to BOTH scatters (item store + user state):
+    # hot users serialize the state RMW exactly like hot items do.
     logic = OnlineMatrixFactorization(
-        num_users, dim, updater=SGDUpdater(0.01), dtype=dtype, mesh=mesh
+        num_users, dim, updater=SGDUpdater(0.01), dtype=dtype, mesh=mesh,
+        state_scatter=(
+            "xla_sorted" if scatter_impl == "xla_sorted" else "xla"
+        ),
     )
     store = ShardedParamStore.create(
         num_items, (dim,), dtype=dtype,
@@ -370,27 +375,35 @@ def tpu_updates_per_sec(
         row_lanes = phys_width(dim)
     else:
         row_lanes = dim
+    # packed dedup (fused kernel windows, xla_sorted physical scatter)
+    # runs at PHYSICAL-row granularity
+    if store.spec.layout == "packed":
+        unique_phys = len(np.unique(items // store.spec.pack))
+    else:
+        unique_phys = unique_items
     if fused:
+        # user side stays on XLA at dense dim (pallas_mf fuses only the
+        # item half); item side touches each unique (physical) row once
         hbm_bytes_per_step = (
-            (3 * batch + 2 * unique_items) * row_lanes * el  # rows
+            (3 * batch * dim + 2 * unique_phys * row_lanes) * el
             + 8 * batch * 4  # id sort/permute passes (int32)
         )
     elif scatter_impl == "xla_sorted":
-        # item side: B-row gather + B-row delta permute (read+write —
+        # per side: B-row gather + B-row delta permute (read+write —
         # jnp.take(deltas, order) materializes in HBM) + UNIQUE-row
-        # scatter RMW + id sort passes; user side unchanged (3 B-row
-        # traversals).  For the packed layout dedup runs at PHYSICAL
-        # granularity (store.push), so count unique physical rows.
-        if store.spec.layout == "packed":
-            uniq = len(np.unique(items // store.spec.pack))
-        else:
-            uniq = unique_items
+        # scatter RMW + id sort passes.  Both sides run sorted (store
+        # push + state_scatter).
+        uniq_i = unique_phys
+        uniq_u = len(np.unique(np.asarray(data["user"])))
+        # user state is always dense (dim lanes); only the store side
+        # moves packed physical rows
         hbm_bytes_per_step = (
-            (3 * batch + 3 * batch + 2 * uniq) * row_lanes * el
-            + 8 * batch * 4
+            ((3 * batch + 2 * uniq_i) * row_lanes
+             + (3 * batch + 2 * uniq_u) * dim) * el
+            + 2 * 8 * batch * 4
         )
     else:
-        hbm_bytes_per_step = 6 * batch * row_lanes * el
+        hbm_bytes_per_step = 3 * batch * (row_lanes + dim) * el
     step_time = dt / bench_steps
     peak = _hbm_peak_bytes_per_sec()
     bandwidth_util = (

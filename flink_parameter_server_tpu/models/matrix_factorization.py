@@ -78,6 +78,7 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
         dtype=jnp.float32,
         dedup_scale: bool = False,
         num_items: Optional[int] = None,
+        state_scatter: str = "xla",
     ):
         self.num_users = num_users
         self.dim = dim
@@ -97,6 +98,16 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
         self.num_items = num_items
         if dedup_scale and num_items is None:
             raise ValueError("dedup_scale=True requires num_items")
+        # state_scatter="xla_sorted": the worker-state update combines
+        # duplicate-user deltas before the scatter (ops/sorted_scatter)
+        # — the same XLA RMW-serialization fix the store side gets from
+        # scatter_impl="xla_sorted"; hot users serialize the plain
+        # scatter exactly like hot items do.
+        if state_scatter not in ("xla", "xla_sorted"):
+            raise ValueError(
+                f"state_scatter={state_scatter!r}: xla|xla_sorted"
+            )
+        self.state_scatter = state_scatter
 
     # -- BatchedWorkerLogic ------------------------------------------------
     def init_state(self, rng: Array) -> Array:
@@ -134,7 +145,14 @@ class OnlineMatrixFactorization(BatchedWorkerLogic):
             user_delta = user_delta * u_scale[..., None].astype(self.dtype)
             item_delta = item_delta * i_scale[..., None].astype(self.dtype)
         m = mask[..., None].astype(self.dtype)
-        state = state.at[users].add(user_delta * m, mode="drop")
+        if self.state_scatter == "xla_sorted":
+            from ..ops.sorted_scatter import sorted_dedup_scatter_add
+
+            state = sorted_dedup_scatter_add(
+                state, users, user_delta * m, mask
+            )
+        else:
+            state = state.at[users].add(user_delta * m, mode="drop")
         out = {"prediction": pred, "error": (ratings - pred) * mask}
         return state, PushRequest(batch["item"], item_delta, mask), out
 

@@ -83,8 +83,12 @@ def sorted_dedup_scatter_add(
     # out-of-bounds ids (see module docstring)
     rep = oob + jnp.arange(n, dtype=jnp.int32)
     rep = rep.at[seg].set(sid)  # duplicate writers carry equal values
+    # rep is ASCENDING by construction: slots 0..nseg-1 hold the sorted
+    # unique ids (all <= oob), slots nseg.. hold oob+slot > oob — so the
+    # scatter can also promise sorted indices to XLA.
     return table.at[rep].add(
-        sums.astype(table.dtype), mode="drop", unique_indices=True
+        sums.astype(table.dtype), mode="drop",
+        unique_indices=True, indices_are_sorted=True,
     )
 
 

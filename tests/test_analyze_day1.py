@@ -1,0 +1,123 @@
+"""The measurement-to-defaults loop (benchmarks/analyze_day1.py) is what
+turns a tunnel window's raw outputs into bench.py's tuned defaults — a
+parsing bug here silently de-tunes the official headline number, so the
+loop gets its own tests: arm-name parsing (including the round-3
+sorted/packed arms), headline-dim pooling, batch pinning only for swept
+variants, spread rendering, and stale-defaults removal.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def analyze(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "analyze_day1", os.path.join(REPO, "benchmarks", "analyze_day1.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "OUT_DIR", str(tmp_path))
+    return mod
+
+
+def _bench_row(value, *, dim=64, scatter="xla", layout="dense",
+               fused=False, platform="tpu", lo=None, hi=None):
+    extra = {
+        "platform": platform, "dim": dim, "scatter_impl": scatter,
+        "layout": layout, "fused_step": fused, "table_dtype": "bfloat16",
+        "bandwidth_util": 0.01,
+    }
+    if lo is not None:
+        extra["rate_min"] = lo
+        extra["rate_max"] = hi
+    return json.dumps({"metric": "m", "value": value,
+                       "unit": "updates/sec/chip", "extra": extra})
+
+
+def test_collect_parses_all_round3_arms(analyze, tmp_path):
+    arms = {
+        "bench_b65536_unfused.out": _bench_row(1e6),
+        "bench_b65536_packed_pallas.out": _bench_row(
+            2e6, scatter="pallas", layout="packed"),
+        "bench_b65536_packed_xla.out": _bench_row(1.5e6, layout="packed"),
+        "bench_b65536_sorted_xla.out": _bench_row(3e6, scatter="xla_sorted"),
+        "bench_b65536_packed_sorted.out": _bench_row(
+            2.5e6, scatter="xla_sorted", layout="packed"),
+        "bench_b65536_fused_d128.out": _bench_row(4e6, dim=128, fused=True),
+    }
+    for name, line in arms.items():
+        (tmp_path / name).write_text(line + "\n")
+    mf, _ = analyze.collect()
+    assert {r["variant"] for r in mf} == {
+        "unfused", "packed_pallas", "packed_xla", "sorted_xla",
+        "packed_sorted", "fused_d128",
+    }
+    assert all(r["batch"] == 65536 for r in mf)
+
+
+def test_choose_defaults_headline_dim_and_batch_pinning(analyze, tmp_path):
+    # sorted_xla wins among dim-64 rows; fused_d128 (higher value) is
+    # excluded from the pool because rates are only comparable at equal
+    # dim.  sorted_xla appears at TWO batches -> batch gets pinned.
+    files = {
+        "bench_b65536_unfused.out": _bench_row(1e6),
+        "bench_b65536_sorted_xla.out": _bench_row(3e6, scatter="xla_sorted"),
+        "bench_b16384_sorted_xla.out": _bench_row(2e6, scatter="xla_sorted"),
+        "bench_b65536_fused_d128.out": _bench_row(9e6, dim=128, fused=True),
+    }
+    for name, line in files.items():
+        (tmp_path / name).write_text(line + "\n")
+    mf, _ = analyze.collect()
+    chosen = analyze.choose_defaults(mf)
+    assert chosen["scatter_impl"] == "xla_sorted"
+    assert chosen["dim"] == 64
+    assert chosen["batch"] == 65536
+    assert chosen["fused"] is False
+
+
+def test_choose_defaults_no_batch_pin_for_single_batch_winner(
+    analyze, tmp_path
+):
+    (tmp_path / "bench_b16384_sorted_xla.out").write_text(
+        _bench_row(3e6, scatter="xla_sorted") + "\n"
+    )
+    mf, _ = analyze.collect()
+    chosen = analyze.choose_defaults(mf)
+    assert chosen["batch"] is None  # timeout-truncated battery: no clamp
+
+
+def test_cpu_rows_and_stale_schema_rows_excluded(analyze, tmp_path):
+    (tmp_path / "bench_b65536_unfused.out").write_text(
+        _bench_row(5e6, platform="cpu") + "\n"
+    )
+    # pre-knob schema: no dim/scatter/layout in extra
+    (tmp_path / "bench_b65536_old.out").write_text(
+        json.dumps({"metric": "m", "value": 1e6, "unit": "u",
+                    "extra": {"platform": "tpu"}}) + "\n"
+    )
+    mf, _ = analyze.collect()
+    assert mf == []
+    assert analyze.choose_defaults(mf) is None
+
+
+def test_render_shows_spread_and_main_removes_stale_defaults(
+    analyze, tmp_path, monkeypatch, capsys
+):
+    (tmp_path / "bench_b65536_sorted_xla.out").write_text(
+        _bench_row(3e6, scatter="xla_sorted", lo=2.8e6, hi=3.3e6) + "\n"
+    )
+    mf, configs = analyze.collect()
+    md = analyze.render(mf, configs, analyze.choose_defaults(mf))
+    assert "2,800,000" in md and "3,300,000" in md  # spread column
+    # a stale chosen_defaults.json must be deleted when no rows survive
+    stale = tmp_path / "chosen_defaults.json"
+    stale.write_text(json.dumps({"scatter_impl": "xla"}))
+    for f in tmp_path.glob("bench_*.out"):
+        f.unlink()
+    analyze.main()
+    assert not stale.exists()

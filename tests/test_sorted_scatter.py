@@ -100,9 +100,11 @@ def test_end_to_end_mf_step_parity():
 
     rng = np.random.default_rng(3)
     users, items, dim, bsz = 32, 64, 16, 256
-    logic = OnlineMatrixFactorization(users, dim, updater=SGDUpdater(0.05))
 
-    def run(impl):
+    def run(impl, state_impl="xla"):
+        logic = OnlineMatrixFactorization(
+            users, dim, updater=SGDUpdater(0.05), state_scatter=state_impl,
+        )
         store = ShardedParamStore.create(
             items, (dim,), dtype=jnp.float32,
             init_fn=normal_factor(0, (dim,)), scatter_impl=impl,
@@ -125,8 +127,11 @@ def test_end_to_end_mf_step_parity():
 
     ta, sa = run("xla")
     tb, sb = run("xla_sorted")
+    tc, sc = run("xla_sorted", state_impl="xla_sorted")  # the bench pairing
     np.testing.assert_allclose(ta, tb, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(sa, sb, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ta, tc, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(sa, sc, rtol=1e-4, atol=1e-5)
 
 
 def test_sharded_sorted_fallback_is_observable(mesh):
