@@ -64,6 +64,21 @@ def bench_scatter(capacity=131_072, dim=128, batch=16_384):
                 f"(unique {uniq}/{batch})"
             )
 
+            # the pure-XLA dedup arm (ops/sorted_scatter) — part of the
+            # same first-minutes verdict as the pallas kernel
+            from flink_parameter_server_tpu.ops.sorted_scatter import (
+                sorted_dedup_scatter_add,
+            )
+
+            srt = jax.jit(
+                lambda t, i, d: sorted_dedup_scatter_add(t, i, d)
+            )
+            t_srt = _timeit(srt, table, ids, deltas)
+            print(
+                f"scatter_xla_sorted[{dname},zipf={zipf}] "
+                f"{t_srt*1e3:.3f} ms/op"
+            )
+
             if jax.default_backend() != "tpu":
                 continue  # interpret mode is not a perf number
             for chunk in (256, 512, 1024, 2048):

@@ -174,6 +174,15 @@ def main():
          "pa", "w2v", "fm"],
         int(900 * scale), env=env_b,
     )
+    env_c = dict(os.environ)
+    env_c.update({"FPS_CFG_SCATTER": "xla_sorted",
+                  "FPS_CFG_LAYOUT": "packed"})
+    job(
+        "baseline_configs_packed_sorted",
+        [py, os.path.join(REPO, "benchmarks", "baseline_configs.py"),
+         "pa", "w2v", "fm"],
+        int(900 * scale), env=env_c,
+    )
 
     # 4b. transformer-LM MFU levers: bigger per-step workload, and the
     # splash flash-attention win at long sequence (auto vs off A/B)
