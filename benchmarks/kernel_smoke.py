@@ -74,6 +74,16 @@ def main():
     )(table, ids, deltas)
     ok &= check("scatter dense d128 f32", got, want, 1e-3)
 
+    # 1b. the pure-XLA dedup arm on the same shapes: its
+    # unique_indices/indices_are_sorted promises must hold compiled
+    # on-chip, not just under the CPU test suite
+    from flink_parameter_server_tpu.ops.sorted_scatter import (
+        sorted_dedup_scatter_add,
+    )
+
+    got_s = jax.jit(sorted_dedup_scatter_add)(table, ids, deltas)
+    ok &= check("scatter xla_sorted d128 f32", got_s, want, 1e-3)
+
     # 2. dense scatter, bf16 table.  The kernel sums a window's deltas in
     # f32 and rounds ONCE per RMW; XLA's scatter rounds per-add — so they
     # legitimately differ on Zipf-hot rows.  Judge both against the f32
