@@ -57,17 +57,138 @@ class DenseParameterServer:
         return self.params
 
 
+def opt_state_zero1_specs(
+    opt_state: PyTree, mesh, dp_axis: str = "dp"
+) -> PyTree:
+    """Per-leaf ZeRO-1 shardings derived from a CONCRETE opt_state.
+
+    Call this on the freshly-initialized (placed) optimizer state:
+    ``optax``'s init builds m/v with ``zeros_like(params)``, so each
+    leaf already carries the PARAMS' sharding (tp/sp model-parallel
+    layouts included).  For every leaf this merges ``dp`` into the
+    first axis that is (a) unsharded in the existing spec and (b)
+    divisible by the dp size — composing with model parallelism rather
+    than clobbering it (forcing ``P(dp, ...)`` on a tp-sharded leaf
+    would *replicate* it across tp and invert the memory win).  Leaves
+    with no eligible axis (scalars like Adam's count, or already
+    dp-sharded) map to ``None`` = leave alone.
+    """
+    if dp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape[dp_axis]
+
+    def spec_for(x):
+        if getattr(x, "ndim", 0) < 1:
+            return None
+        cur: tuple = ()
+        sharding = getattr(x, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        if spec is not None:
+            cur = tuple(spec)
+        cur = cur + (None,) * (x.ndim - len(cur))
+        used = set()
+        for e in cur:
+            if isinstance(e, str):
+                used.add(e)
+            elif isinstance(e, (tuple, list)):
+                used.update(e)
+        if dp_axis in used:
+            return None  # already dp-sharded somewhere
+        for i in range(x.ndim):
+            if cur[i] is None and x.shape[i] % dp == 0:
+                merged = cur[:i] + (dp_axis,) + cur[i + 1:]
+                return NamedSharding(mesh, P(*merged))
+        return None
+
+    return jax.tree.map(spec_for, opt_state)
+
+
+def shard_opt_state_constraint(
+    opt_state: PyTree, mesh, dp_axis: str = "dp", specs: PyTree = None
+) -> PyTree:
+    """Cross-replica weight-update sharding (ZeRO-1 done the XLA way).
+
+    Constrain optimizer-state leaves to dp-sharded layouts.  Under jit,
+    XLA propagates the constraint backward/forward: the gradient
+    allreduce becomes reduce_scatter, each replica runs the optimizer
+    math only for its 1/dp parameter slice, and the updates all_gather
+    back — same collective bytes as the plain allreduce, but Adam's
+    m/v (8 bytes/param fp32) stop being replicated.  This is the
+    sharding-annotation form of automatic cross-replica weight-update
+    sharding; nothing here hand-schedules a collective.
+
+    ``specs``: pytree from :func:`opt_state_zero1_specs` (None entries =
+    leave the leaf alone).  Without it, the fallback shards each leaf's
+    LEADING axis over dp when divisible — correct for pure-dp meshes;
+    for tp/sp-sharded models pass ``specs`` so dp merges into a free
+    axis instead of clobbering the model-parallel layout.
+    """
+    if dp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
+        )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dp = mesh.shape[dp_axis]
+
+    if specs is not None:
+        return jax.tree.map(
+            lambda x, s: (
+                jax.lax.with_sharding_constraint(x, s) if s is not None
+                else x
+            ),
+            opt_state, specs,
+        )
+
+    def constrain(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % dp == 0:
+            spec = P(dp_axis, *([None] * (x.ndim - 1)))
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec)
+            )
+        return x
+
+    return jax.tree.map(constrain, opt_state)
+
+
 def make_dense_train_step(
     loss_fn: Callable[[PyTree, Any], Array],
     optimizer: optax.GradientTransformation,
+    *,
+    mesh=None,
+    dp_axis: str = "dp",
+    shard_opt_state: bool = False,
+    opt_specs: PyTree = None,
 ) -> Callable:
     """Fused pull → grad → push step (jit this).  ``loss_fn(params,
     batch) -> scalar``; gradients are averaged across the dp axis by XLA
-    from the shardings alone."""
+    from the shardings alone.
+
+    ``shard_opt_state=True`` (requires ``mesh``): optimizer state is
+    dp-sharded via :func:`shard_opt_state_constraint` — ZeRO-1 memory
+    scaling for the dense PS path.  For tp/sp-sharded models also pass
+    ``opt_specs=opt_state_zero1_specs(server.opt_state, mesh)`` so dp
+    merges into a free axis of each leaf instead of overwriting the
+    model-parallel layout."""
+    if shard_opt_state:
+        if mesh is None:
+            raise ValueError("shard_opt_state=True requires mesh")
+        if dp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
+            )
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if shard_opt_state:
+            opt_state = shard_opt_state_constraint(
+                opt_state, mesh, dp_axis, specs=opt_specs
+            )
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
@@ -112,4 +233,10 @@ def transform_dense(
     )
 
 
-__all__ = ["DenseParameterServer", "make_dense_train_step", "transform_dense"]
+__all__ = [
+    "DenseParameterServer",
+    "make_dense_train_step",
+    "opt_state_zero1_specs",
+    "shard_opt_state_constraint",
+    "transform_dense",
+]

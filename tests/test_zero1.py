@@ -1,0 +1,138 @@
+"""ZeRO-1 optimizer-state sharding for the dense PS path
+(core/dense.shard_opt_state_constraint): the dp-sharded weight update
+must be numerically identical to the replicated one, and Adam's m/v
+must actually come back dp-sharded (the memory win is the point).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from flink_parameter_server_tpu.core.dense import (
+    DenseParameterServer,
+    make_dense_train_step,
+)
+
+
+def _setup(mesh=None, shard_opt_state=False):
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": jnp.asarray(rng.normal(0, 0.1, (16, 32)), jnp.float32),
+        "b1": jnp.asarray(np.zeros(32), jnp.float32),
+        "w2": jnp.asarray(rng.normal(0, 0.1, (32, 4)), jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+        out = h @ p["w2"]
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    opt = optax.adam(1e-2)
+    server = DenseParameterServer(params, opt)
+    step = jax.jit(
+        make_dense_train_step(
+            loss_fn, opt, mesh=mesh, dp_axis="dp",
+            shard_opt_state=shard_opt_state,
+        )
+    )
+    return server, step
+
+
+def _batches(n=4, b=64):
+    r = np.random.default_rng(1)
+    return [
+        {"x": jnp.asarray(r.normal(size=(b, 16)), jnp.float32),
+         "y": jnp.asarray(r.normal(size=(b, 4)), jnp.float32)}
+        for _ in range(n)
+    ]
+
+
+def test_zero1_matches_replicated(devices):
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    batches = _batches()
+
+    server_a, step_a = _setup()
+    pa, oa = server_a.params, server_a.opt_state
+    for batch in batches:
+        pa, oa, loss_a = step_a(pa, oa, batch)
+
+    server_b, step_b = _setup(mesh=mesh, shard_opt_state=True)
+    pb, ob = server_b.params, server_b.opt_state
+    sh = NamedSharding(mesh, P("dp"))
+    for batch in batches:
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+        pb, ob, loss_b = step_b(pb, ob, batch)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        pa, pb,
+    )
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+
+    # the memory win: adam's mu/nu for the (16, 32) weight come back
+    # dp-sharded along the leading axis, not replicated
+    mu_w1 = ob[0].mu["w1"]
+    spec = mu_w1.sharding.spec
+    assert spec and spec[0] == "dp", (spec, mu_w1.sharding)
+    # leaves whose leading dim does not divide dp stay replicated
+    mu_b1 = ob[0].mu["b1"]  # shape (32,): 32 % 8 == 0 -> sharded too
+    assert mu_b1.sharding.spec and mu_b1.sharding.spec[0] == "dp"
+    nu_w2 = ob[0].nu["w2"]  # (32, 4) -> sharded
+    assert nu_w2.sharding.spec and nu_w2.sharding.spec[0] == "dp"
+
+
+def test_zero1_requires_mesh():
+    with pytest.raises(ValueError, match="requires mesh"):
+        make_dense_train_step(
+            lambda p, b: jnp.float32(0), optax.sgd(0.1),
+            shard_opt_state=True,
+        )
+
+
+def test_zero1_requires_dp_axis_in_mesh(devices):
+    mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("data", "model"))
+    with pytest.raises(ValueError, match="data.*model|not in mesh"):
+        make_dense_train_step(
+            lambda p, b: jnp.float32(0), optax.sgd(0.1),
+            mesh=mesh, shard_opt_state=True,
+        )
+
+
+def test_zero1_specs_compose_with_tp(devices):
+    """opt_state_zero1_specs must MERGE dp into a free axis of a
+    tp-sharded leaf, never overwrite the model-parallel layout (the
+    overwrite would replicate m/v across tp — memory win inverted)."""
+    from flink_parameter_server_tpu.core.dense import opt_state_zero1_specs
+
+    mesh = Mesh(np.array(devices[:8]).reshape(4, 2), ("dp", "tp"))
+    rng = np.random.default_rng(0)
+    params = {
+        # column-parallel: axis 1 sharded over tp
+        "wqkv": jax.device_put(
+            jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+            NamedSharding(mesh, P(None, "tp")),
+        ),
+        # row-parallel: axis 0 sharded over tp
+        "wo": jax.device_put(
+            jnp.asarray(rng.normal(size=(8, 16)), jnp.float32),
+            NamedSharding(mesh, P("tp", None)),
+        ),
+        # replicated vector
+        "b": jax.device_put(
+            jnp.asarray(np.zeros(16), jnp.float32),
+            NamedSharding(mesh, P(None)),
+        ),
+    }
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    specs = opt_state_zero1_specs(opt_state, mesh)
+    mu_specs = specs[0].mu
+    assert tuple(mu_specs["wqkv"].spec) == ("dp", "tp")
+    assert tuple(mu_specs["wo"].spec) == ("tp", "dp")
+    assert tuple(mu_specs["b"].spec) == ("dp",)
+    # scalar count leaf: left alone
+    assert specs[0].count is None
