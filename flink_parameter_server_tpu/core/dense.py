@@ -57,22 +57,12 @@ class DenseParameterServer:
         return self.params
 
 
-def opt_state_zero1_specs(
-    opt_state: PyTree, mesh, dp_axis: str = "dp"
-) -> PyTree:
-    """Per-leaf ZeRO-1 shardings derived from a CONCRETE opt_state.
-
-    Call this on the freshly-initialized (placed) optimizer state:
-    ``optax``'s init builds m/v with ``zeros_like(params)``, so each
-    leaf already carries the PARAMS' sharding (tp/sp model-parallel
-    layouts included).  For every leaf this merges ``dp`` into the
-    first axis that is (a) unsharded in the existing spec and (b)
-    divisible by the dp size — composing with model parallelism rather
-    than clobbering it (forcing ``P(dp, ...)`` on a tp-sharded leaf
-    would *replicate* it across tp and invert the memory win).  Leaves
-    with no eligible axis (scalars like Adam's count, or already
-    dp-sharded) map to ``None`` = leave alone.
-    """
+def _merged_dp_specs(tree: PyTree, mesh, dp_axis: str) -> PyTree:
+    """Per-leaf shardings merging ``dp`` into each CONCRETE leaf's
+    existing spec on the first unsharded dp-divisible axis (None =
+    leave the leaf alone: scalars, already-dp-sharded, no eligible
+    axis).  Composes with tp/sp model-parallel layouts rather than
+    clobbering them."""
     if dp_axis not in mesh.axis_names:
         raise ValueError(
             f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
@@ -104,7 +94,39 @@ def opt_state_zero1_specs(
                 return NamedSharding(mesh, P(*merged))
         return None
 
-    return jax.tree.map(spec_for, opt_state)
+    return jax.tree.map(spec_for, tree)
+
+
+def opt_state_zero1_specs(
+    opt_state: PyTree, mesh, dp_axis: str = "dp"
+) -> PyTree:
+    """Per-leaf ZeRO-1 shardings derived from a CONCRETE opt_state.
+
+    Call this on the freshly-initialized (placed) optimizer state:
+    ``optax``'s init builds m/v with ``zeros_like(params)``, so each
+    leaf already carries the PARAMS' sharding (tp/sp model-parallel
+    layouts included); ``dp`` merges into the first free divisible axis
+    (forcing ``P(dp, ...)`` on a tp-sharded leaf would *replicate* it
+    across tp and invert the memory win)."""
+    return _merged_dp_specs(opt_state, mesh, dp_axis)
+
+
+def fsdp_place(params: PyTree, mesh, dp_axis: str = "dp") -> PyTree:
+    """FSDP (ZeRO-3 analogue) placement: re-shard CONCRETE params over
+    ``dp`` (merged into each leaf's existing tp/sp spec on a free
+    axis).  Nothing else changes: under jit, XLA all_gathers a weight
+    right where a matmul consumes it and reduce_scatters its gradient —
+    the per-layer gather/release schedule FSDP implementations hand-roll
+    is GSPMD's normal propagation here.  ``optimizer.init`` on the
+    returned params inherits the sharded layout (zeros_like), so
+    optimizer state is 1/dp too: params + grads + opt state all scale
+    down with the mesh, at the cost of per-use weight all_gathers.
+    """
+    specs = _merged_dp_specs(params, mesh, dp_axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s) if s is not None else x,
+        params, specs,
+    )
 
 
 def shard_opt_state_constraint(
@@ -122,37 +144,34 @@ def shard_opt_state_constraint(
     sharding; nothing here hand-schedules a collective.
 
     ``specs``: pytree from :func:`opt_state_zero1_specs` (None entries =
-    leave the leaf alone).  Without it, the fallback shards each leaf's
-    LEADING axis over dp when divisible — correct for pure-dp meshes;
-    for tp/sp-sharded models pass ``specs`` so dp merges into a free
-    axis instead of clobbering the model-parallel layout.
+    leave the leaf alone).  Without it, specs are derived from the
+    leaves in place — inside jit those are tracers with no sharding, so
+    the derivation sees every axis as free and shards the first
+    dp-divisible one.  That is correct ONLY on a pure-dp mesh; a
+    multi-axis mesh without explicit ``specs`` is rejected (silently
+    re-sharding a tp-sharded leaf to dp-only would replicate it across
+    tp — the exact memory win inverted).
     """
     if dp_axis not in mesh.axis_names:
         raise ValueError(
             f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
         )
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    dp = mesh.shape[dp_axis]
-
-    if specs is not None:
-        return jax.tree.map(
-            lambda x, s: (
-                jax.lax.with_sharding_constraint(x, s) if s is not None
-                else x
-            ),
-            opt_state, specs,
-        )
-
-    def constrain(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % dp == 0:
-            spec = P(dp_axis, *([None] * (x.ndim - 1)))
-            return jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, spec)
+    if specs is None:
+        if len(mesh.axis_names) > 1:
+            raise ValueError(
+                f"mesh has axes {mesh.axis_names}: pass "
+                f"specs=opt_state_zero1_specs(initial_opt_state, mesh) "
+                f"so dp merges with the model-parallel layout instead "
+                f"of overwriting it"
             )
-        return x
-
-    return jax.tree.map(constrain, opt_state)
+        specs = _merged_dp_specs(opt_state, mesh, dp_axis)
+    return jax.tree.map(
+        lambda x, s: (
+            jax.lax.with_sharding_constraint(x, s) if s is not None
+            else x
+        ),
+        opt_state, specs,
+    )
 
 
 def make_dense_train_step(
@@ -180,6 +199,13 @@ def make_dense_train_step(
         if dp_axis not in mesh.axis_names:
             raise ValueError(
                 f"dp_axis={dp_axis!r} not in mesh axes {mesh.axis_names}"
+            )
+        if opt_specs is None and len(mesh.axis_names) > 1:
+            raise ValueError(
+                f"mesh has axes {mesh.axis_names}: pass "
+                f"opt_specs=opt_state_zero1_specs(server.opt_state, mesh) "
+                f"so dp merges with the model-parallel layout instead of "
+                f"overwriting it"
             )
 
     def step(params, opt_state, batch):
@@ -235,6 +261,7 @@ def transform_dense(
 
 __all__ = [
     "DenseParameterServer",
+    "fsdp_place",
     "make_dense_train_step",
     "opt_state_zero1_specs",
     "shard_opt_state_constraint",

@@ -78,11 +78,43 @@ def test_zero1_matches_replicated(devices):
     mu_w1 = ob[0].mu["w1"]
     spec = mu_w1.sharding.spec
     assert spec and spec[0] == "dp", (spec, mu_w1.sharding)
-    # leaves whose leading dim does not divide dp stay replicated
     mu_b1 = ob[0].mu["b1"]  # shape (32,): 32 % 8 == 0 -> sharded too
     assert mu_b1.sharding.spec and mu_b1.sharding.spec[0] == "dp"
     nu_w2 = ob[0].nu["w2"]  # (32, 4) -> sharded
     assert nu_w2.sharding.spec and nu_w2.sharding.spec[0] == "dp"
+
+
+def test_zero1_non_divisible_leaf_stays_replicated(devices):
+    """A leaf with NO dp-divisible axis must be left alone by the
+    constraint (scalars and odd shapes), not crash or mis-shard."""
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(0, 0.1, (16, 32)), jnp.float32),
+        "odd": jnp.asarray(rng.normal(0, 0.1, (3, 5)), jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        return jnp.mean(
+            (batch["x"] @ p["w"]) ** 2
+        ) + jnp.sum(p["odd"] ** 2)
+
+    opt = optax.adam(1e-2)
+    step = jax.jit(
+        make_dense_train_step(
+            loss_fn, opt, mesh=mesh, shard_opt_state=True,
+        )
+    )
+    batch = {"x": jax.device_put(
+        jnp.asarray(rng.normal(size=(64, 16)), jnp.float32),
+        NamedSharding(mesh, P("dp")),
+    )}
+    p, o, loss = step(params, opt.init(params), batch)
+    assert np.isfinite(float(loss))
+    # (3, 5): neither axis divides dp=8 -> replicated
+    assert o[0].mu["odd"].sharding.spec in (P(), P(None), P(None, None))
+    # (16, 32) -> dp-sharded
+    assert o[0].mu["w"].sharding.spec[0] == "dp"
 
 
 def test_zero1_requires_mesh():
@@ -100,6 +132,55 @@ def test_zero1_requires_dp_axis_in_mesh(devices):
             lambda p, b: jnp.float32(0), optax.sgd(0.1),
             mesh=mesh, shard_opt_state=True,
         )
+
+
+def test_fsdp_matches_replicated(devices):
+    """fsdp_place shards params over dp; training must be numerically
+    identical to the replicated run, with params AND optimizer state
+    coming back dp-sharded (the ZeRO-3 memory point)."""
+    from flink_parameter_server_tpu.core.dense import fsdp_place
+
+    mesh = Mesh(np.array(devices[:8]), ("dp",))
+    batches = _batches()
+
+    server_a, step_a = _setup()
+    pa, oa = server_a.params, server_a.opt_state
+    for batch in batches:
+        pa, oa, loss_a = step_a(pa, oa, batch)
+
+    rng = np.random.default_rng(0)
+    params = fsdp_place(
+        {
+            "w1": jnp.asarray(rng.normal(0, 0.1, (16, 32)), jnp.float32),
+            "b1": jnp.asarray(np.zeros(32), jnp.float32),
+            "w2": jnp.asarray(rng.normal(0, 0.1, (32, 4)), jnp.float32),
+        },
+        mesh,
+    )
+    assert params["w1"].sharding.spec[0] == "dp"
+    opt = optax.adam(1e-2)
+    server_b = DenseParameterServer(params, opt)
+    # m/v inherit the fsdp layout from optax's zeros_like init
+    assert server_b.opt_state[0].mu["w1"].sharding.spec[0] == "dp"
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - batch["y"]) ** 2)
+
+    step_b = jax.jit(make_dense_train_step(loss_fn, opt))
+    pb, ob = server_b.params, server_b.opt_state
+    sh = NamedSharding(mesh, P("dp"))
+    for batch in batches:
+        batch = {k: jax.device_put(v, sh) for k, v in batch.items()}
+        pb, ob, loss_b = step_b(pb, ob, batch)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        pa, pb,
+    )
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
 
 
 def test_zero1_specs_compose_with_tp(devices):
