@@ -80,9 +80,13 @@ class CountMinSketch(BatchedWorkerLogic):
         out = {"estimate": jnp.min(pulled, axis=1)}
         return state, PushRequest(self.keys(batch), deltas, lane_mask), out
 
-    def make_store(self, *, mesh=None) -> ShardedParamStore:
+    def make_store(self, *, mesh=None, **store_opts) -> ShardedParamStore:
+        # store_opts passes through scatter_impl/layout: a Zipf text
+        # stream hammers the same hot cells every batch, the exact case
+        # scatter_impl="xla_sorted" exists for
         return ShardedParamStore.create(
-            self.config.capacity, (), init_fn=zeros(()), mesh=mesh
+            self.config.capacity, (), init_fn=zeros(()), mesh=mesh,
+            **store_opts,
         )
 
     def query(self, store: ShardedParamStore, keys: Array) -> Array:
@@ -187,9 +191,10 @@ class TugOfWarSketch(BatchedWorkerLogic):
         )
         return state, PushRequest(self.keys(batch), deltas, lane_mask), {}
 
-    def make_store(self, *, mesh=None) -> ShardedParamStore:
+    def make_store(self, *, mesh=None, **store_opts) -> ShardedParamStore:
         return ShardedParamStore.create(
-            self.config.num_estimators, (), init_fn=zeros(()), mesh=mesh
+            self.config.num_estimators, (), init_fn=zeros(()), mesh=mesh,
+            **store_opts,
         )
 
     def estimate_f2(self, store: ShardedParamStore) -> Array:

@@ -148,6 +148,35 @@ def test_sharded_sorted_fallback_is_observable(mesh):
     assert store_mod.pallas_fallback_count() == n0 + 1
 
 
+def test_countmin_sketch_parity():
+    """Count-min on a Zipf stream — the hot-cell case — must estimate
+    identically on xla and xla_sorted stores."""
+    from flink_parameter_server_tpu.core.transform import make_train_step
+    from flink_parameter_server_tpu.models.sketches import (
+        CountMinConfig,
+        CountMinSketch,
+    )
+
+    cfg = CountMinConfig(depth=3, width=64)
+    sketch = CountMinSketch(cfg)
+    rng = np.random.default_rng(7)
+    words = jnp.asarray(((rng.zipf(1.3, 2048) - 1) % 100).astype(np.int32))
+    batch = {"key": words, "mask": jnp.ones(2048, bool)}
+
+    def run(impl):
+        store = sketch.make_store(scatter_impl=impl)
+        step = jax.jit(make_train_step(sketch, store.spec))
+        table, _, _ = step(store.table, sketch.init_state(None), batch)
+        return np.asarray(
+            sketch.query(
+                ShardedParamStore(spec=store.spec, table=table),
+                jnp.arange(100, dtype=jnp.int32),
+            )
+        )
+
+    np.testing.assert_allclose(run("xla"), run("xla_sorted"), rtol=1e-6)
+
+
 def test_scalar_store_parity():
     """PA-style scalar rows (value_shape=())."""
     rng = np.random.default_rng(5)
