@@ -138,6 +138,21 @@ class StoreSpec:
     #   pallas-kernel eligibility at any width.  Requires update="add".
     layout: str = "dense"
 
+    def __post_init__(self) -> None:
+        # A user who configured a specific impl must never silently not
+        # get it: a typo like "sorted" or "xla-sorted" would otherwise
+        # fall through every `== "pallas"` / `== "xla_sorted"` dispatch
+        # and run the plain XLA scatter without a word.
+        valid = ("xla", "pallas", "xla_sorted")
+        if self.scatter_impl not in valid:
+            raise ValueError(
+                f"scatter_impl={self.scatter_impl!r} is not one of {valid}"
+            )
+        if self.layout not in ("dense", "packed"):
+            raise ValueError(
+                f"layout={self.layout!r} is not one of ('dense', 'packed')"
+            )
+
     @property
     def num_shards(self) -> int:
         if self.mesh is None:

@@ -58,6 +58,19 @@ def sorted_dedup_scatter_add(
     if oob is None:
         oob = rows
     n = ids.shape[0]
+    if oob < rows:
+        # oob below the table would make the routed-out lanes land on a
+        # REAL row (id ``oob``) and add their un-zeroed delta sums to it
+        # — the drop contract would be silently violated.
+        raise ValueError(f"oob={oob} must be >= table rows ({rows})")
+    if oob + n - 1 > jnp.iinfo(jnp.int32).max:
+        # rep ids run up to oob + n - 1 in int32 lanes; beyond that they
+        # wrap negative and mode="drop" can no longer be trusted to drop
+        # them.  Tables this close to 2**31 rows need a sharded store
+        # (per-shard local ids), not a bigger flat id space.
+        raise ValueError(
+            f"oob + n - 1 = {oob + n - 1} overflows int32 id space"
+        )
     ids = ids.astype(jnp.int32)
     if mask is not None:
         ids = jnp.where(mask, ids, oob)
