@@ -34,64 +34,70 @@ def _timeit(fn, *args, warmup=2, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def bench_scatter(capacity=131_072, dim=128, batch=16_384):
-    """XLA scatter-add vs the Pallas sorted-run kernel under skew.
-
-    On TPU this is the `chunk`-tuning run the scatter_impl default hangs
-    on: a skew (zipf) x chunk sweep, one line each, bf16 and fp32."""
+def bench_scatter(capacity=131_072, dims=(17, 64, 128), batch=16_384):
+    """XLA scatter-add vs the dedup arms under skew — the A/B grid the
+    scatter_impl default hangs on (VERDICT r3 next #1a): skew
+    {uniform, zipf 1.05, 1.2, 1.3} x dims {17, 64, 128} x {fp32, bf16},
+    xla vs xla_sorted everywhere; on TPU the Pallas kernel's chunk sweep
+    runs at its dense-eligible dim 128 (narrow dims take the packed
+    layout, A/B'd by the battery's bench variants instead)."""
     import jax
     import jax.numpy as jnp
 
     from flink_parameter_server_tpu.ops.pallas_scatter import scatter_add
+    from flink_parameter_server_tpu.ops.sorted_scatter import (
+        sorted_dedup_scatter_add,
+    )
 
     rng = np.random.default_rng(0)
+    skews = ("uniform", 1.05, 1.2, 1.3)
     for dtype in (jnp.float32, jnp.bfloat16):
         dname = jnp.dtype(dtype).name
-        table = jnp.zeros((capacity, dim), dtype)
-        for zipf in (1.1, 1.2, 1.5):
-            ids = jnp.asarray(
-                ((rng.zipf(zipf, batch) - 1) % capacity).astype(np.int32)
-            )
-            deltas = jnp.asarray(
-                rng.normal(0, 1, (batch, dim)).astype(np.float32)
-            )
-            uniq = len(np.unique(np.asarray(ids)))
-
-            xla = jax.jit(lambda t, i, d: t.at[i].add(d.astype(t.dtype)))
-            t_xla = _timeit(xla, table, ids, deltas)
-            print(
-                f"scatter_xla[{dname},zipf={zipf}] {t_xla*1e3:.3f} ms/op "
-                f"(unique {uniq}/{batch})"
-            )
-
-            # the pure-XLA dedup arm (ops/sorted_scatter) — part of the
-            # same first-minutes verdict as the pallas kernel
-            from flink_parameter_server_tpu.ops.sorted_scatter import (
-                sorted_dedup_scatter_add,
-            )
-
-            srt = jax.jit(
-                lambda t, i, d: sorted_dedup_scatter_add(t, i, d)
-            )
-            t_srt = _timeit(srt, table, ids, deltas)
-            print(
-                f"scatter_xla_sorted[{dname},zipf={zipf}] "
-                f"{t_srt*1e3:.3f} ms/op"
-            )
-
-            if jax.default_backend() != "tpu":
-                continue  # interpret mode is not a perf number
-            for chunk in (256, 512, 1024, 2048):
-                pl = jax.jit(
-                    lambda t, i, d, c=chunk: scatter_add(
-                        t, i, d, chunk=c, interpret=False
-                    )
+        for dim in dims:
+            table = jnp.zeros((capacity, dim), dtype)
+            for zipf in skews:
+                if zipf == "uniform":
+                    ids_np = rng.integers(0, capacity, batch)
+                else:
+                    ids_np = (rng.zipf(zipf, batch) - 1) % capacity
+                ids = jnp.asarray(ids_np.astype(np.int32))
+                deltas = jnp.asarray(
+                    rng.normal(0, 1, (batch, dim)).astype(np.float32)
                 )
-                t_pl = _timeit(pl, table, ids, deltas)
+                uniq = len(np.unique(np.asarray(ids)))
+                tag = f"{dname},d{dim},zipf={zipf}"
+
+                xla = jax.jit(
+                    lambda t, i, d: t.at[i].add(d.astype(t.dtype))
+                )
+                t_xla = _timeit(xla, table, ids, deltas)
                 print(
-                    f"scatter_pallas[{dname},zipf={zipf},chunk={chunk}] "
-                    f"{t_pl*1e3:.3f} ms/op"
+                    f"scatter_xla[{tag}] {t_xla*1e3:.3f} ms/op "
+                    f"(unique {uniq}/{batch})"
                 )
+
+                srt = jax.jit(
+                    lambda t, i, d: sorted_dedup_scatter_add(t, i, d)
+                )
+                t_srt = _timeit(srt, table, ids, deltas)
+                print(
+                    f"scatter_xla_sorted[{tag}] {t_srt*1e3:.3f} ms/op "
+                    f"(vs_xla {t_xla/t_srt:.2f}x)"
+                )
+
+                if jax.default_backend() != "tpu" or dim != 128:
+                    continue  # interpret mode is not a perf number
+                for chunk in (256, 512, 1024, 2048):
+                    pl = jax.jit(
+                        lambda t, i, d, c=chunk: scatter_add(
+                            t, i, d, chunk=c, interpret=False
+                        )
+                    )
+                    t_pl = _timeit(pl, table, ids, deltas)
+                    print(
+                        f"scatter_pallas[{tag},chunk={chunk}] "
+                        f"{t_pl*1e3:.3f} ms/op (vs_xla {t_xla/t_pl:.2f}x)"
+                    )
     if jax.default_backend() != "tpu":
         print("scatter_pallas skipped (no TPU)")
 

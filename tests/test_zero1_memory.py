@@ -1,0 +1,76 @@
+"""Measured memory scaling for ZeRO-1 / FSDP (VERDICT r3 next #6).
+
+`benchmarks/zero1_memory.py` records live per-device shard bytes after a
+real jitted step; this test pins the RATIOS at a small LM config so the
+claimed 1/dp scaling is asserted, not narrated:
+
+  * ZeRO-1: optimizer state ~1/8 of replicated, params unchanged.
+  * FSDP: params + optimizer state both ~1/8.
+"""
+import pytest
+
+
+@pytest.fixture(scope="module")
+def payload(devices, monkeypatch_module=None):
+    import benchmarks.zero1_memory as zm
+
+    # small dp-divisible config: keep the 3 jitted LM steps cheap
+    import os
+
+    env = {
+        "FPS_LM_VOCAB": "1024", "FPS_LM_DMODEL": "64",
+        "FPS_LM_LAYERS": "2", "FPS_LM_HEADS": "4",
+        "FPS_LM_DFF": "128", "FPS_LM_SEQ": "32",
+    }
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        return zm.main(argv=[])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _row(payload, regime):
+    return next(r for r in payload["rows"] if r["regime"] == regime)
+
+
+def test_zero1_opt_state_is_one_over_dp(payload):
+    repl = _row(payload, "replicated")
+    z1 = _row(payload, "zero1")
+    n = payload["n_devices"]
+    # params START replicated under ZeRO-1 (placement as configured)...
+    assert (
+        z1["params_bytes_before_step"] == repl["params_bytes_per_dev"]
+    )
+    # ...and m/v shard to ~1/dp (scalars like adam's count replicated)
+    ratio = z1["opt_bytes_per_dev"] / repl["opt_bytes_per_dev"]
+    assert 1 / n * 0.9 < ratio < 1 / n * 1.5, ratio
+    # Measured (results/cpu/zero1_memory.json): GSPMD propagates the
+    # opt-state constraint through apply_updates to the params OUTPUT,
+    # so post-step params may come back dp-sharded too — the memory win
+    # is AT LEAST the m/v shard, not more than replicated.
+    assert (
+        z1["params_bytes_per_dev"] <= repl["params_bytes_per_dev"]
+    )
+    assert z1["total_bytes_per_dev"] <= repl["total_bytes_per_dev"] * 0.5
+
+
+def test_fsdp_params_and_opt_are_one_over_dp(payload):
+    repl = _row(payload, "replicated")
+    fs = _row(payload, "fsdp")
+    n = payload["n_devices"]
+    ratio = fs["total_bytes_per_dev"] / repl["total_bytes_per_dev"]
+    assert 1 / n * 0.9 < ratio < 1 / n * 1.8, ratio
+
+
+def test_all_regimes_trained(payload):
+    # each regime ran a REAL step (loss finite) — placement that dies on
+    # first use would be a vacuous memory table
+    import math
+
+    for r in payload["rows"]:
+        assert math.isfinite(r["loss"]), r
