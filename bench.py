@@ -101,7 +101,8 @@ def _measured_defaults(jax, path=None) -> dict:
     # measured set wholesale.  Batch is orthogonal and keeps its own
     # env-vs-measured resolution.
     variant_env = [k for k in ("FPS_BENCH_FUSED", "FPS_BENCH_DIM",
-                               "FPS_BENCH_SCATTER", "FPS_BENCH_LAYOUT")
+                               "FPS_BENCH_SCATTER", "FPS_BENCH_LAYOUT",
+                               "FPS_BENCH_PRESORT")
                    if k in os.environ]
     if variant_env:
         print(f"# explicit {','.join(variant_env)} set: ignoring measured "
@@ -211,6 +212,12 @@ def tpu_updates_per_sec(
         )
     if layout not in ("dense", "packed", "auto"):
         raise SystemExit(f"FPS_BENCH_LAYOUT={layout!r}: dense|packed|auto")
+    presort_raw = os.environ.get(
+        "FPS_BENCH_PRESORT", "1" if measured.get("presort") else "0"
+    )
+    if presort_raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_PRESORT={presort_raw!r}: 0|1")
+    presort = presort_raw == "1"
     # validated up front with the other knobs: a typo must exit in
     # milliseconds, not after burning a tunnel window on compile+warmup
     raw_reps = os.environ.get("FPS_BENCH_REPS", "3")
@@ -259,6 +266,9 @@ def tpu_updates_per_sec(
 
     # (interpret mode on CPU is not a perf number — flag ignored there)
     fused = fused_requested and jax.default_backend() == "tpu"
+    # the fused kernel sorts internally (sorted-window DMA); a batch
+    # presort would be a second sort reported under the wrong knob
+    presort = presort and not fused
 
     if scatter_impl == "pallas" and jax.default_backend() != "tpu":
         # interpreter-mode pallas at bench batch sizes would wedge the
@@ -327,7 +337,7 @@ def tpu_updates_per_sec(
         )
         step = jax.jit(raw_step, donate_argnums=(0, 1))
     else:
-        raw_step = make_train_step(logic, store.spec)
+        raw_step = make_train_step(logic, store.spec, presort=presort)
         step = jax.jit(raw_step, donate_argnums=(0, 1))
     table = store.table
     for _ in range(warmup_steps):
@@ -362,40 +372,48 @@ def tpu_updates_per_sec(
     # ONE jitted lax.scan, so the host round trip amortizes to 1/K and
     # the per-step quotient is the device latency the kernels actually
     # set — the number a kernel win moves and tunnel noise cannot.
-    raw_k = os.environ.get("FPS_BENCH_DEVICE_P50_STEPS", "64")
+    # K defaults by platform: 64 amortizes the ~75 ms tunnel RTT to
+    # <2% of a ~2 ms step on TPU; off-TPU there is no RTT to amortize,
+    # so a small K just confirms the scan path.  0 disables the scan
+    # entirely (profiler jobs do this: 6xK extra steps inside a trace
+    # window would bury the 10 steady-state steps it wants).
+    default_k = "64" if jax.default_backend() == "tpu" else "8"
+    raw_k = os.environ.get("FPS_BENCH_DEVICE_P50_STEPS", default_k)
     try:
         scan_k = int(raw_k)
     except ValueError:
         raise SystemExit(
-            f"FPS_BENCH_DEVICE_P50_STEPS={raw_k!r}: expected a positive "
-            f"integer"
+            f"FPS_BENCH_DEVICE_P50_STEPS={raw_k!r}: expected a "
+            f"non-negative integer (0 disables the device-p50 scan)"
         ) from None
-    if scan_k <= 0:
+    if scan_k < 0:
         raise SystemExit(
-            f"FPS_BENCH_DEVICE_P50_STEPS={scan_k}: must be positive"
+            f"FPS_BENCH_DEVICE_P50_STEPS={scan_k}: must be >= 0"
         )
 
-    def _scan_steps(table, state):
-        def body(carry, _):
-            t, s = carry
-            t, s, _out = raw_step(t, s, data)
-            return (t, s), None
+    p50_device_ms = None
+    if scan_k:
+        def _scan_steps(table, state):
+            def body(carry, _):
+                t, s = carry
+                t, s, _out = raw_step(t, s, data)
+                return (t, s), None
 
-        carry, _ = jax.lax.scan(
-            body, (table, state), None, length=scan_k
-        )
-        return carry
+            carry, _ = jax.lax.scan(
+                body, (table, state), None, length=scan_k
+            )
+            return carry
 
-    scan_fn = jax.jit(_scan_steps, donate_argnums=(0, 1))
-    table, state = scan_fn(table, state)  # compile + warm
-    jax.block_until_ready(table)
-    dev_lats = []
-    for _ in range(5):
-        t2 = time.perf_counter()
-        table, state = scan_fn(table, state)
+        scan_fn = jax.jit(_scan_steps, donate_argnums=(0, 1))
+        table, state = scan_fn(table, state)  # compile + warm
         jax.block_until_ready(table)
-        dev_lats.append((time.perf_counter() - t2) / scan_k)
-    p50_device_ms = float(np.percentile(np.array(dev_lats), 50) * 1e3)
+        dev_lats = []
+        for _ in range(5):
+            t2 = time.perf_counter()
+            table, state = scan_fn(table, state)
+            jax.block_until_ready(table)
+            dev_lats.append((time.perf_counter() - t2) / scan_k)
+        p50_device_ms = float(np.percentile(np.array(dev_lats), 50) * 1e3)
 
     # HBM traffic model for the gather/scatter-bound MF step (the honest
     # perf yardstick for a bandwidth-bound workload).  Unfused: each side
@@ -459,6 +477,7 @@ def tpu_updates_per_sec(
         "dim": dim,
         "scatter_impl": scatter_impl,
         "layout": layout,
+        "presort": presort,
         "reps": reps,
         "rate_min": float(np.min(rep_rates)) / n_chips,
         "rate_max": float(np.max(rep_rates)) / n_chips,
@@ -537,7 +556,7 @@ _TPU_ARTIFACT = os.environ.get("FPS_BENCH_TPU_ARTIFACT") or os.path.join(
 _PIN_KNOBS = (
     "FPS_BENCH_FUSED", "FPS_BENCH_DIM", "FPS_BENCH_SCATTER",
     "FPS_BENCH_LAYOUT", "FPS_BENCH_BATCH", "FPS_BENCH_DTYPE",
-    "FPS_BENCH_FUSED_CHUNK",
+    "FPS_BENCH_FUSED_CHUNK", "FPS_BENCH_PRESORT",
 )
 
 
@@ -628,7 +647,10 @@ def main():
             # this image); device is the scan-amortized kernel latency
             "pull_push_p50_ms": round(r["p50_ms"], 3),
             "p50_e2e_ms": round(r["p50_ms"], 3),
-            "p50_device_ms": round(r["p50_device_ms"], 3),
+            "p50_device_ms": (
+                round(r["p50_device_ms"], 3)
+                if r["p50_device_ms"] is not None else None
+            ),
             "batch": r["batch"],
             "per_record_baseline_updates_per_sec": round(cpu_rate, 1),
             "baseline_finite": baseline_finite,
@@ -640,6 +662,7 @@ def main():
             "dim": r["dim"],
             "scatter_impl": r["scatter_impl"],
             "layout": r["layout"],
+            "presort": r["presort"],
             "reps": r["reps"],
             "rate_min": round(r["rate_min"], 1),
             "rate_max": round(r["rate_max"], 1),

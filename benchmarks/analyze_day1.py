@@ -112,6 +112,7 @@ def choose_defaults(mf):
         "fused": bool(extra.get("fused_step")),
         "dim": extra.get("dim", HEADLINE_DIM),
         "dtype": extra.get("table_dtype", "bfloat16"),
+        "presort": bool(extra.get("presort")),
     }
 
 
@@ -140,7 +141,8 @@ def render(mf, configs, chosen):
             f"**Chosen default**: `{chosen['source']}` "
             f"({chosen['updates_per_sec']:,.0f} updates/sec — "
             f"scatter={chosen['scatter_impl']}, layout={chosen['layout']}, "
-            f"fused={chosen['fused']}, dim={chosen['dim']})", "",
+            f"fused={chosen['fused']}, dim={chosen['dim']}, "
+            f"presort={chosen['presort']})", "",
         ]
     if configs:
         lines += ["## Baseline configs", "",

@@ -84,6 +84,18 @@ def main():
     got_s = jax.jit(sorted_dedup_scatter_add)(table, ids, deltas)
     ok &= check("scatter xla_sorted d128 f32", got_s, want, 1e-3)
 
+    # 1c. the ids_sorted fast path (batch presort feeds this): its
+    # skipped-argsort + indices_are_sorted promise must hold COMPILED
+    # on the real chip, where a violated promise may miscompile
+    ids_asc = jnp.sort(ids)
+    deltas_by_order = jnp.take(deltas, jnp.argsort(ids), axis=0)
+    want_sorted = table.at[ids_asc].add(deltas_by_order)
+    got_fast = jax.jit(
+        lambda t, i, dl: sorted_dedup_scatter_add(t, i, dl, ids_sorted=True)
+    )(table, ids_asc, deltas_by_order)
+    ok &= check("scatter xla_sorted ids_sorted d128 f32",
+                got_fast, want_sorted, 1e-3)
+
     # 2. dense scatter, bf16 table.  The kernel sums a window's deltas in
     # f32 and rounds ONCE per RMW; XLA's scatter rounds per-add — so they
     # legitimately differ on Zipf-hot rows.  Judge both against the f32

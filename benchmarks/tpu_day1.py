@@ -106,6 +106,13 @@ def main():
         [py, os.path.join(REPO, "benchmarks", "microbench.py"), "mf_fused"],
         int(600 * scale),
     )
+    # approx-top-k unit: throughput + MEASURED recall vs exact at 1M
+    # rows (only meaningful on-chip — approx_max_k is exact off-TPU)
+    job(
+        "microbench_topk",
+        [py, os.path.join(REPO, "benchmarks", "microbench.py"), "topk"],
+        int(600 * scale),
+    )
 
     # 2. headline bench, bf16 — the step variants A/B'd at the decision
     #    batch (64k) first, then the other batches.
@@ -127,6 +134,17 @@ def main():
         ("packed_sorted", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
                            "FPS_BENCH_SCATTER": "xla_sorted",
                            "FPS_BENCH_LAYOUT": "packed"}),
+        # batch presort (HBM locality on every table touch): on plain
+        # XLA scatter, and composed with the dedup arm (whose argsort
+        # it subsumes)
+        ("presort_xla", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                         "FPS_BENCH_SCATTER": "xla",
+                         "FPS_BENCH_LAYOUT": "dense",
+                         "FPS_BENCH_PRESORT": "1"}),
+        ("presort_sorted", {"FPS_BENCH_FUSED": "0", "FPS_BENCH_DIM": "64",
+                            "FPS_BENCH_SCATTER": "xla_sorted",
+                            "FPS_BENCH_LAYOUT": "dense",
+                            "FPS_BENCH_PRESORT": "1"}),
         ("fused_d128", {"FPS_BENCH_FUSED": "1", "FPS_BENCH_DIM": "128",
                         "FPS_BENCH_SCATTER": "xla",
                         "FPS_BENCH_LAYOUT": "dense"}),
@@ -139,6 +157,7 @@ def main():
             env = dict(os.environ)
             env["FPS_BENCH_BATCH"] = str(batch)
             env["FPS_BENCH_DTYPE"] = "bfloat16"
+            env["FPS_BENCH_PRESORT"] = "0"  # arms opt in explicitly
             env.update(extra_env)
             job(
                 f"bench_b{batch}_{tag}",
@@ -212,7 +231,11 @@ def main():
     # 5. profiler trace of the MF step (the fused-kernel decision input).
     # One untraced call first: same shapes -> the jit cache is warm, so
     # the trace captures steady-state steps, not compilation
-    # (tracing.profile_trace's own guidance).
+    # (tracing.profile_trace's own guidance).  Device-p50 scan OFF: its
+    # 6xK extra steps inside the trace window would bury the 10
+    # steady-state steps this job exists to capture.
+    env_prof = dict(os.environ)
+    env_prof["FPS_BENCH_DEVICE_P50_STEPS"] = "0"
     job(
         "mf_profile",
         [py, "-c", (
@@ -227,7 +250,7 @@ def main():
             "    bench.tpu_updates_per_sec(warmup_steps=1, bench_steps=10)\n"
             "print('trace saved')\n"
         ) % (REPO, os.path.join(OUT_DIR, "mf_trace"))],
-        int(600 * scale),
+        int(600 * scale), env=env_prof,
     )
 
     # 6. distill the battery into chosen_defaults.json, then one UNTUNED
@@ -253,6 +276,29 @@ def main():
         "bench_final_tuned",
         [py, os.path.join(REPO, "bench.py")],
         int(600 * scale), env=env_final,
+    )
+    # the AFTER trace of the before/after roofline pair (VERDICT r3 next
+    # #2): same shapes as mf_profile, but run after analyze_day1 so the
+    # unpinned knobs adopt the freshly measured chosen_defaults — the
+    # trace shows where the step time goes under the WINNING variant
+    env_tuned_trace = {
+        k: v for k, v in env_final.items() if k != "FPS_BENCH_BATCH"
+    }
+    env_tuned_trace["FPS_BENCH_BATCH"] = "65536"
+    env_tuned_trace["FPS_BENCH_DEVICE_P50_STEPS"] = "0"
+    job(
+        "mf_profile_tuned",
+        [py, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "import jax\n"
+            "from flink_parameter_server_tpu.training import tracing\n"
+            "import bench\n"
+            "bench.tpu_updates_per_sec(bench_steps=2)  # compile+warm\n"
+            "with tracing.profile_trace(%r):\n"
+            "    bench.tpu_updates_per_sec(warmup_steps=1, bench_steps=10)\n"
+            "print('trace saved')\n"
+        ) % (REPO, os.path.join(OUT_DIR, "mf_trace_tuned"))],
+        int(600 * scale), env=env_tuned_trace,
     )
     print(f"summary -> {os.path.join(OUT_DIR, 'summary.json')}")
     return 0

@@ -292,6 +292,8 @@ def push(
     ids: Array,
     deltas: Array,
     mask: Optional[Array] = None,
+    *,
+    ids_sorted: bool = False,
 ) -> Array:
     """Batched push: fold ``deltas`` into rows ``ids`` (sharded scatter).
 
@@ -299,6 +301,15 @@ def push(
     jit-friendly replacement for the reference's variable-length message
     batches (SURVEY.md §7 "Dynamic shapes").  Out-of-range ids are dropped
     (``mode="drop"``), matching :func:`..parallel.collectives.shard_push_add`.
+
+    ``ids_sorted=True`` is the caller's promise that ``ids`` is ascending
+    with any NEGATIVE lanes at the end (make_train_step's ``presort``
+    sorts by the routed key, which guarantees exactly this): the
+    plain-"xla" scatter then tells XLA ``indices_are_sorted`` (any shard
+    count — that branch never reorders lanes) and the single-shard
+    "xla_sorted" skips its own argsort.  The shard_map pushes
+    (pallas / sharded xla_sorted) ignore it — their dp all_gather
+    concatenation is only piecewise sorted.
     """
     vr = len(spec.value_shape)
     lead = tuple(deltas.shape[: deltas.ndim - vr])
@@ -425,9 +436,13 @@ def push(
             if spec.num_shards == 1:
                 from ..ops.sorted_scatter import sorted_dedup_scatter_add
 
+                # ids_sorted survives _phys_scatter_args: the packed
+                # physical id (logical // pack) is monotone and the
+                # negative-lane sentinel (padded_capacity, routed above)
+                # maps to exactly the physical row count = oob
                 return sorted_dedup_scatter_add(
                     table, s_ids, s_deltas, None,
-                    oob=table.shape[0],
+                    oob=table.shape[0], ids_sorted=ids_sorted,
                 )
             from ..parallel.collectives import shard_push_add
 
@@ -445,8 +460,11 @@ def push(
                 f"flat batch {n} not divisible by "
                 f"dp={spec.mesh.shape[dp_axis]}",
             )
+        # (valid even sharded: this branch never reorders lanes — GSPMD
+        # sees the logical, still-ascending id array)
         return table.at[s_ids].add(
-            s_deltas.astype(table.dtype), mode="drop"
+            s_deltas.astype(table.dtype), mode="drop",
+            indices_are_sorted=ids_sorted,
         )
 
     # Generic path: combine duplicates densely, then apply `update` once per

@@ -173,6 +173,9 @@ def ps_online_mf(
     seed: int = 0,
     mesh: Optional[Mesh] = None,
     dedup_scale: bool = False,
+    scatter_impl: str = "xla",
+    layout: str = "dense",
+    state_scatter: Optional[str] = None,
     **transform_kwargs,
 ):
     """End-to-end wrapper mirroring ``PSOnlineMatrixFactorization.psOnlineMF``
@@ -181,9 +184,18 @@ def ps_online_mf(
     ``ratings``: iterable of microbatch dicts (user, item, rating, mask).
     Returns the :class:`TransformResult`; ``result.store.values()`` is the
     final item-factor matrix, ``result.worker_state`` the user factors.
+
+    ``scatter_impl`` / ``layout`` reach the item store (see
+    :class:`~..core.store.StoreSpec`); ``state_scatter`` the user-state
+    update — it defaults to following ``scatter_impl``, since hot users
+    serialize the state RMW exactly like hot items do.
     """
     from ..core.transform import transform_batched
 
+    if state_scatter is None:
+        state_scatter = (
+            "xla_sorted" if scatter_impl == "xla_sorted" else "xla"
+        )
     logic = OnlineMatrixFactorization(
         num_users,
         dim,
@@ -192,12 +204,15 @@ def ps_online_mf(
         mesh=mesh,
         dedup_scale=dedup_scale,
         num_items=num_items if dedup_scale else None,
+        state_scatter=state_scatter,
     )
     store = ShardedParamStore.create(
         num_items,
         (dim,),
         init_fn=ranged_random_factor(seed + 1, (dim,)),
         mesh=mesh,
+        scatter_impl=scatter_impl,
+        layout=layout,
     )
     return transform_batched(
         ratings, logic, store, rng=jax.random.PRNGKey(seed), mesh=mesh,

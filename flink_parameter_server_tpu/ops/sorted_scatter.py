@@ -46,6 +46,7 @@ def sorted_dedup_scatter_add(
     mask: Optional[Array] = None,
     *,
     oob: Optional[int] = None,
+    ids_sorted: bool = False,
 ) -> Array:
     """``table.at[ids].add(deltas)`` with duplicates pre-combined.
 
@@ -53,6 +54,16 @@ def sorted_dedup_scatter_add(
     are dropped.  ``deltas``: (n, *value_shape).  ``mask``: optional (n,)
     bool — masked lanes are dropped (their ids are routed out of bounds,
     so they cannot even contribute a zero-add to a hot row's segment).
+
+    ``ids_sorted=True`` is the caller's PROMISE that ``ids`` is already
+    ascending with any invalid lanes at the END (e.g. a batch pre-sorted
+    by :func:`~..core.transform.make_train_step`'s ``presort`` with
+    negatives routed to the sentinel before sorting) — the argsort +
+    delta permute are skipped, saving two batch-sized HBM passes.  The
+    in-range clamp below maps every id above ``oob`` to exactly ``oob``,
+    which keeps an ascending input ascending, so the
+    ``indices_are_sorted`` promise to XLA stays honest.  Ignored when
+    ``mask`` is given (mask routing moves lanes out of order).
     """
     rows = table.shape[0]
     if oob is None:
@@ -74,6 +85,7 @@ def sorted_dedup_scatter_add(
     ids = ids.astype(jnp.int32)
     if mask is not None:
         ids = jnp.where(mask, ids, oob)
+        ids_sorted = False  # mask routing breaks the caller's ordering
     # Route negatives (would wrap before mode="drop") AND any id beyond
     # ``oob`` to exactly ``oob``: sorted ids then never exceed ``oob``,
     # so the empty-slot reps ``oob + slot`` (slot >= 1) cannot collide
@@ -81,9 +93,12 @@ def sorted_dedup_scatter_add(
     # arbitrary caller ids.
     ids = jnp.where((ids < 0) | (ids > oob), oob, ids)
 
-    order = jnp.argsort(ids)
-    sid = jnp.take(ids, order)
-    sdl = jnp.take(deltas, order, axis=0)
+    if ids_sorted:
+        sid, sdl = ids, deltas
+    else:
+        order = jnp.argsort(ids)
+        sid = jnp.take(ids, order)
+        sdl = jnp.take(deltas, order, axis=0)
 
     first = jnp.concatenate(
         [jnp.ones((1,), bool), sid[1:] != sid[:-1]]

@@ -51,6 +51,7 @@ def test_graft_entry_compiles():
     assert out.shape == (256,)
 
 
+@pytest.mark.slow
 def test_graft_dryrun_multichip_8():
     g = _load_graft()
     g.dryrun_multichip(8)  # asserts internally; covers MF + transformer

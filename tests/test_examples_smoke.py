@@ -66,6 +66,7 @@ def test_word2vec_example():
     assert "neighbours" in r.stdout
 
 
+@pytest.mark.slow
 def test_transformer_lm_example():
     r = _run([os.path.join("examples", "transformer_lm.py")])
     assert r.returncode == 0, r.stderr[-2000:]

@@ -52,6 +52,7 @@ def test_hybrid_mf_matches_event_backend_math():
     assert len(res_hy.worker_outputs) == len(res_ev.worker_outputs)
 
 
+@pytest.mark.slow
 def test_hybrid_chunked_converges(mesh):
     """Chunked (bounded-staleness) hybrid on a sharded store converges."""
     rng = np.random.default_rng(1)

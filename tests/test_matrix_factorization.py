@@ -207,3 +207,41 @@ def test_query_topk_on_packed_store():
     sd2, idd2 = query_topk(dense, users, uids, k=5, exclude=excl)
     sp2, idp2 = query_topk(packed, users, uids, k=5, exclude=excl)
     np.testing.assert_array_equal(np.asarray(idd2), np.asarray(idp2))
+
+
+@pytest.mark.parametrize(
+    "knobs",
+    [
+        {"scatter_impl": "xla_sorted"},
+        {"scatter_impl": "xla_sorted", "layout": "packed"},
+        {"layout": "packed"},
+    ],
+)
+def test_ps_online_mf_scatter_layout_knobs_match_default(knobs):
+    """The canonical wrapper must reach the store's scatter/layout knobs
+    (and follow scatter_impl for the user-state update) without changing
+    the math: identical stream -> near-identical factors vs default.
+    (Exact equality is not required: dedup changes f32 summation order.)
+    """
+    data = synthetic_ratings(100, 150, 6_000, rank=4, noise=0.01, seed=3)
+
+    def run(**kw):
+        stream = microbatches(data, batch_size=256, epochs=2,
+                              shuffle_seed=0)
+        return ps_online_mf(
+            stream, num_users=100, num_items=150, dim=8,
+            learning_rate=0.08, seed=0, collect_outputs=False, **kw,
+        )
+
+    base = run()
+    alt = run(**knobs)
+    np.testing.assert_allclose(
+        np.asarray(alt.store.values()),
+        np.asarray(base.store.values()),
+        rtol=0, atol=5e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(alt.worker_state),
+        np.asarray(base.worker_state),
+        rtol=0, atol=5e-5,
+    )

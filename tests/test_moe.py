@@ -25,6 +25,7 @@ def _x(n=64, seed=0):
     )
 
 
+@pytest.mark.slow
 def test_ep_matches_oracle_single_dp():
     mesh = make_mesh(1, 8, axis_names=("dp", "ep"))
     params = init_moe_params(jax.random.PRNGKey(0), CFG, mesh)
@@ -37,6 +38,7 @@ def test_ep_matches_oracle_single_dp():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ep_with_dp_matches_per_shard_oracle():
     """Capacity is per dp shard: the oracle applies to each dp half."""
     mesh = make_mesh(2, 4, axis_names=("dp", "ep"))

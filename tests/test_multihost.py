@@ -33,6 +33,7 @@ def _free_port() -> int:
     os.environ.get("FPS_SKIP_MULTIHOST") == "1",
     reason="multihost smoke disabled by env",
 )
+@pytest.mark.slow
 def test_two_process_distributed_smoke():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     child = os.path.join(repo, "tests", "_multihost_child.py")

@@ -148,6 +148,7 @@ def test_fused_oob_items_dropped():
     )
 
 
+@pytest.mark.slow
 def test_fused_sharded_matches_single_shard():
     """ps-only sharded fused step == single-shard fused step == unfused
     reference, with the one-psum assembly."""

@@ -57,6 +57,7 @@ def test_pair_key_symmetric():
 @pytest.mark.parametrize(
     "B,T,H,D,sp", [(1, 16, 1, 4, 8), (3, 64, 2, 16, 4), (2, 24, 5, 8, 2)]
 )
+@pytest.mark.slow
 def test_ring_attention_shape_sweep(B, T, H, D, sp):
     from flink_parameter_server_tpu.parallel.ring_attention import (
         reference_attention,

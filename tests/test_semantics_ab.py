@@ -18,6 +18,7 @@ from flink_parameter_server_tpu.models.matrix_factorization import (
     ps_online_mf,
 )
 from flink_parameter_server_tpu.utils.initializers import ranged_random_factor
+import pytest
 
 
 def _rmse(user_f, item_f, data):
@@ -25,6 +26,7 @@ def _rmse(user_f, item_f, data):
     return float(np.sqrt(np.mean((pred - data["rating"]) ** 2)))
 
 
+@pytest.mark.slow
 def test_batched_matches_per_record_convergence():
     num_users, num_items, dim = 48, 64, 6
     data = synthetic_ratings(num_users, num_items, 3000, rank=3,

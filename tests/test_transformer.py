@@ -137,6 +137,7 @@ def test_sp_ring_transformer_matches_dense(sp_mesh):
     )
 
 
+@pytest.mark.slow
 def test_ring_attention_bf16_fp32_accumulators(sp_mesh):
     """bf16 inputs must accumulate in fp32: result within bf16 resolution
     of the fp32 reference."""
@@ -298,6 +299,7 @@ def test_pipelined_ring_attention_composition():
     )
 
 
+@pytest.mark.slow
 def test_pipelined_ring_attention_gradients():
     """PP × SP gradients (ppermute inside scan inside the pipeline
     shard_map) match the dense oracle."""

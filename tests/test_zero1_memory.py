@@ -9,9 +9,11 @@ claimed 1/dp scaling is asserted, not narrated:
 """
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
-def payload(devices, monkeypatch_module=None):
+def payload(devices):
     import benchmarks.zero1_memory as zm
 
     # small dp-divisible config: keep the 3 jitted LM steps cheap
