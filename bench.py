@@ -73,6 +73,7 @@ def _measured_defaults(jax, path=None) -> dict:
         and (measured.get("dim") is None
              or (isinstance(measured.get("dim"), int)
                  and measured["dim"] > 0))
+        and isinstance(measured.get("presort", False), bool)
     )
     if not ok:
         print(f"# ignoring malformed {path}", file=sys.stderr)
@@ -114,7 +115,8 @@ def _measured_defaults(jax, path=None) -> dict:
           f"scatter={measured.get('scatter_impl')} "
           f"layout={measured.get('layout')} "
           f"fused={measured.get('fused')} "
-          f"dim={measured.get('dim')}", file=sys.stderr)
+          f"dim={measured.get('dim')} "
+          f"presort={measured.get('presort', False)}", file=sys.stderr)
     return measured
 
 
@@ -437,6 +439,10 @@ def tpu_updates_per_sec(
         unique_phys = len(np.unique(items // store.spec.pack))
     else:
         unique_phys = unique_items
+    # batch presort (make_train_step): one argsort over the routed ids
+    # plus a permute (read+write) of the four batch columns
+    # (user+item int32, rating f32, mask bool)
+    presort_bytes = (8 * batch * 4 + 2 * batch * 13) if presort else 0
     if fused:
         # user side stays on XLA at dense dim (pallas_mf fuses only the
         # item half); item side touches each unique (physical) row once
@@ -448,7 +454,9 @@ def tpu_updates_per_sec(
         # per side: B-row gather + B-row delta permute (read+write —
         # jnp.take(deltas, order) materializes in HBM) + UNIQUE-row
         # scatter RMW + id sort passes.  Both sides run sorted (store
-        # push + state_scatter).
+        # push + state_scatter); under presort the store-side argsort
+        # is subsumed by the batch sort (ids_sorted fast path), so only
+        # the user-state sort remains.
         uniq_i = unique_phys
         uniq_u = len(np.unique(np.asarray(data["user"])))
         # user state is always dense (dim lanes); only the store side
@@ -456,10 +464,13 @@ def tpu_updates_per_sec(
         hbm_bytes_per_step = (
             ((3 * batch + 2 * uniq_i) * row_lanes
              + (3 * batch + 2 * uniq_u) * dim) * el
-            + 2 * 8 * batch * 4
+            + (1 if presort else 2) * 8 * batch * 4
+            + presort_bytes
         )
     else:
-        hbm_bytes_per_step = 3 * batch * (row_lanes + dim) * el
+        hbm_bytes_per_step = (
+            3 * batch * (row_lanes + dim) * el + presort_bytes
+        )
     step_time = dt / bench_steps
     peak = _hbm_peak_bytes_per_sec()
     bandwidth_util = (

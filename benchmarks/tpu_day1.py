@@ -281,9 +281,8 @@ def main():
     # #2): same shapes as mf_profile, but run after analyze_day1 so the
     # unpinned knobs adopt the freshly measured chosen_defaults — the
     # trace shows where the step time goes under the WINNING variant
-    env_tuned_trace = {
-        k: v for k, v in env_final.items() if k != "FPS_BENCH_BATCH"
-    }
+    # env_final already excludes every pin knob (incl. FPS_BENCH_BATCH)
+    env_tuned_trace = dict(env_final)
     env_tuned_trace["FPS_BENCH_BATCH"] = "65536"
     env_tuned_trace["FPS_BENCH_DEVICE_P50_STEPS"] = "0"
     job(

@@ -144,3 +144,88 @@ def test_presort_sharded_matches(mesh):
     t_b, s_b, _ = sorted_step(store.table, state0, b)
     np.testing.assert_allclose(np.asarray(t_a), np.asarray(t_b), atol=2e-5)
     np.testing.assert_allclose(np.asarray(s_a), np.asarray(s_b), atol=2e-5)
+
+
+@pytest.mark.parametrize("spc", [2, 3])
+def test_steps_per_call_matches_single_dispatch(spc):
+    """K steps per jitted dispatch (lax.scan) must be per-step identical
+    to the one-dispatch-per-batch loop — including a tail shorter than K
+    and per-batch worker outputs."""
+    from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+    from flink_parameter_server_tpu.data.streams import microbatches
+
+    data = synthetic_ratings(60, 90, 2_000, rank=4, noise=0.01, seed=4)
+
+    def run(steps_per_call):
+        logic = OnlineMatrixFactorization(
+            60, 8, updater=SGDUpdater(0.08), seed=0
+        )
+        store = ShardedParamStore.create(
+            90, (8,), init_fn=normal_factor(1, (8,)),
+        )
+        return transform_batched(
+            microbatches(data, 256, epochs=1, shuffle_seed=0),
+            logic, store, rng=jax.random.PRNGKey(0),
+            steps_per_call=steps_per_call,
+        )
+
+    a, b = run(1), run(spc)
+    np.testing.assert_allclose(
+        np.asarray(a.store.values()), np.asarray(b.store.values()),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.worker_state), np.asarray(b.worker_state), atol=1e-6,
+    )
+    assert len(a.worker_outputs) == len(b.worker_outputs)
+    for oa, ob in zip(a.worker_outputs, b.worker_outputs):
+        ja, jb = jax.tree.leaves(oa), jax.tree.leaves(ob)
+        for xa, xb in zip(ja, jb):
+            np.testing.assert_allclose(
+                np.asarray(xa), np.asarray(xb), atol=1e-6
+            )
+
+
+def test_steps_per_call_rejects_state_callback():
+    from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+    from flink_parameter_server_tpu.data.streams import microbatches
+
+    data = synthetic_ratings(60, 90, 500, rank=2, seed=5)
+    logic = OnlineMatrixFactorization(60, 4, updater=SGDUpdater(0.05))
+    store = ShardedParamStore.create(90, (4,))
+    with pytest.raises(ValueError, match="steps_per_call"):
+        transform_batched(
+            microbatches(data, 128, epochs=1), logic, store,
+            steps_per_call=2, state_callback=lambda *a: None,
+        )
+
+
+def test_steps_per_call_sharded_mesh(mesh):
+    """The scan path on a dp x ps mesh: dp shard moves to axis 1 of the
+    stacked batches; results must match the per-dispatch mesh run."""
+    from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+    from flink_parameter_server_tpu.data.streams import microbatches
+
+    data = synthetic_ratings(64, 96, 2_048, rank=4, noise=0.01, seed=6)
+
+    def run(steps_per_call):
+        logic = OnlineMatrixFactorization(
+            64, 8, updater=SGDUpdater(0.08), seed=0, mesh=mesh
+        )
+        store = ShardedParamStore.create(
+            96, (8,), init_fn=normal_factor(1, (8,)), mesh=mesh,
+        )
+        return transform_batched(
+            microbatches(data, 256, epochs=1, shuffle_seed=0),
+            logic, store, rng=jax.random.PRNGKey(0), mesh=mesh,
+            collect_outputs=False, steps_per_call=steps_per_call,
+        )
+
+    a, b = run(1), run(4)
+    np.testing.assert_allclose(
+        np.asarray(a.store.values()), np.asarray(b.store.values()),
+        atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.worker_state), np.asarray(b.worker_state), atol=2e-5,
+    )
