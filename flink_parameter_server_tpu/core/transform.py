@@ -327,6 +327,15 @@ def make_train_step(
     def step(table, state, batch):
         if presort:
             ids0 = jnp.asarray(logic.keys(batch)).astype(jnp.int32)
+            if ids0.ndim != 1:
+                # multi-pull logics (e.g. PA: (B, K) feature ids) have
+                # no single per-record sort key — argsort along the
+                # wrong axis would silently permute garbage
+                raise ValueError(
+                    f"presort=True needs 1-D store keys, got shape "
+                    f"{tuple(ids0.shape)} (multi-pull logics are not "
+                    f"presortable)"
+                )
             # sort by the ROUTED key (negatives at the END, on the
             # sentinel push itself uses) so the order survives push's
             # negative-lane routing and the ids_sorted promise is honest

@@ -229,3 +229,24 @@ def test_steps_per_call_sharded_mesh(mesh):
     np.testing.assert_allclose(
         np.asarray(a.worker_state), np.asarray(b.worker_state), atol=2e-5,
     )
+
+
+def test_presort_rejects_multi_pull_keys():
+    """PA-style logics pull (B, K) feature ids per example — there is no
+    single per-record sort key; presort must refuse loudly instead of
+    permuting along the wrong axis."""
+    from flink_parameter_server_tpu.models.passive_aggressive import (
+        transform_binary,
+    )
+
+    rng = np.random.default_rng(7)
+    B, K, F = 64, 4, 256
+    batches = [{
+        "ids": jnp.asarray(rng.integers(0, F, (B, K)).astype(np.int32)),
+        "values": jnp.asarray(rng.normal(size=(B, K)).astype(np.float32)),
+        "feat_mask": jnp.ones((B, K), bool),
+        "label": jnp.asarray(rng.integers(0, 2, B) * 2 - 1, jnp.int32),
+        "mask": jnp.ones(B, bool),
+    }]
+    with pytest.raises(ValueError, match="1-D store keys"):
+        transform_binary(batches, num_features=F, presort=True)
