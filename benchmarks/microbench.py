@@ -55,6 +55,26 @@ def bench_scatter(capacity=131_072, dims=(17, 64, 128), batch=16_384):
         dname = jnp.dtype(dtype).name
         for dim in dims:
             table = jnp.zeros((capacity, dim), dtype)
+            # ONE jit per (dtype, dim) per impl, shared across every
+            # skew (same shapes -> same program): a fresh jit per skew
+            # would recompile identical programs and burn the tunnel
+            # window's job budget on compiles
+            xla = jax.jit(
+                lambda t, i, d: t.at[i].add(d.astype(t.dtype))
+            )
+            srt = jax.jit(
+                lambda t, i, d: sorted_dedup_scatter_add(t, i, d)
+            )
+            pallas_jits = {}
+            if jax.default_backend() == "tpu" and dim == 128:
+                pallas_jits = {
+                    chunk: jax.jit(
+                        lambda t, i, d, c=chunk: scatter_add(
+                            t, i, d, chunk=c, interpret=False
+                        )
+                    )
+                    for chunk in (256, 512, 1024, 2048)
+                }
             for zipf in skews:
                 if zipf == "uniform":
                     ids_np = rng.integers(0, capacity, batch)
@@ -67,32 +87,19 @@ def bench_scatter(capacity=131_072, dims=(17, 64, 128), batch=16_384):
                 uniq = len(np.unique(np.asarray(ids)))
                 tag = f"{dname},d{dim},zipf={zipf}"
 
-                xla = jax.jit(
-                    lambda t, i, d: t.at[i].add(d.astype(t.dtype))
-                )
                 t_xla = _timeit(xla, table, ids, deltas)
                 print(
                     f"scatter_xla[{tag}] {t_xla*1e3:.3f} ms/op "
                     f"(unique {uniq}/{batch})"
                 )
 
-                srt = jax.jit(
-                    lambda t, i, d: sorted_dedup_scatter_add(t, i, d)
-                )
                 t_srt = _timeit(srt, table, ids, deltas)
                 print(
                     f"scatter_xla_sorted[{tag}] {t_srt*1e3:.3f} ms/op "
                     f"(vs_xla {t_xla/t_srt:.2f}x)"
                 )
 
-                if jax.default_backend() != "tpu" or dim != 128:
-                    continue  # interpret mode is not a perf number
-                for chunk in (256, 512, 1024, 2048):
-                    pl = jax.jit(
-                        lambda t, i, d, c=chunk: scatter_add(
-                            t, i, d, chunk=c, interpret=False
-                        )
-                    )
+                for chunk, pl in pallas_jits.items():
                     t_pl = _timeit(pl, table, ids, deltas)
                     print(
                         f"scatter_pallas[{tag},chunk={chunk}] "
