@@ -306,10 +306,11 @@ def push(
     with any NEGATIVE lanes at the end (make_train_step's ``presort``
     sorts by the routed key, which guarantees exactly this): the
     plain-"xla" scatter then tells XLA ``indices_are_sorted`` (any shard
-    count — that branch never reorders lanes) and the single-shard
-    "xla_sorted" skips its own argsort.  The shard_map pushes
-    (pallas / sharded xla_sorted) ignore it — their dp all_gather
-    concatenation is only piecewise sorted.
+    count — that branch never reorders lanes) and "xla_sorted" skips its
+    argsort at ANY shard count (the dp split of a sorted array is
+    contiguous chunks, reassembled in order by the tiled all_gather —
+    see :func:`..parallel.collectives.shard_push_add`).  The pallas
+    shard_map push ignores it (the kernel sorts in-kernel).
     """
     vr = len(spec.value_shape)
     lead = tuple(deltas.shape[: deltas.ndim - vr])
@@ -449,10 +450,13 @@ def push(
             n = s_ids.shape[0]
             dp_axis, divisible = _dp_axis_and_divisible(spec.mesh, n)
             if divisible:
+                # the dp split of a globally sorted id array is
+                # contiguous chunks, reassembled in order by the tiled
+                # all_gather — the promise survives sharding
                 return shard_push_add(
                     table, s_ids, s_deltas, None,
                     mesh=spec.mesh, ps_axis=spec.ps_axis, dp_axis=dp_axis,
-                    impl="xla_sorted",
+                    impl="xla_sorted", ids_sorted=ids_sorted,
                 )
             # plain XLA scatter is still correct — but never silent
             _note_scatter_fallback(

@@ -97,6 +97,7 @@ def shard_push_add(
     ps_axis: str = "ps",
     dp_axis: Optional[str] = "dp",
     impl: str = "xla",
+    ids_sorted: bool = False,
 ) -> Array:
     """Sharded scatter-add: each ``ps`` shard folds in only the rows it
     owns.  When a ``dp`` axis exists, each worker's deltas are first
@@ -108,6 +109,14 @@ def shard_push_add(
     read-modify-write per unique local row under Zipf-hot ids.
     ``impl="xla_sorted"``: the same dedup in pure XLA
     (:mod:`..ops.sorted_scatter`) — no Mosaic shape constraints.
+
+    ``ids_sorted=True`` (xla_sorted only): the caller promises GLOBALLY
+    ascending flat ids (batch presort).  The dp split is then contiguous
+    chunks of a sorted array and the tiled all_gather reassembles them
+    in dp order, so each shard sees ascending ids whose in-range run is
+    contiguous: below-range lanes clip to 0 with zeroed deltas (order-
+    preserving zero-adds) and above-range lanes clip to the oob sentinel
+    — the per-shard argsort + delta permute are skipped entirely.
     """
     value_rank = table.ndim - 1
     if impl == "pallas":
@@ -165,6 +174,26 @@ def shard_push_add(
         if impl == "xla_sorted":
             from ..ops.sorted_scatter import sorted_dedup_scatter_add
 
+            if ids_sorted:
+                # ascending rel: [negatives][this shard's run][>= rows].
+                # Routing misses via the mask would break the order
+                # (oob lands in front), so instead zero their deltas
+                # and clip low lanes to row 0 — ascending survives and
+                # the zero-adds are numerically inert.
+                d = local_deltas.reshape((-1,) + local_table.shape[1:])
+                d = jnp.where(
+                    hit.reshape((-1,) + (1,) * value_rank),
+                    d,
+                    jnp.zeros_like(d),
+                )
+                return sorted_dedup_scatter_add(
+                    local_table,
+                    jnp.clip(rel, 0, rows),
+                    d,
+                    None,
+                    oob=rows,
+                    ids_sorted=True,
+                )
             return sorted_dedup_scatter_add(
                 local_table,
                 rel,

@@ -125,9 +125,13 @@ def test_presort_transform_batched_end_to_end():
     )
 
 
-def test_presort_sharded_matches(mesh):
-    """Presort on a dp x ps mesh: the plain-xla sharded scatter takes the
-    indices_are_sorted promise; results must match the unsorted mesh run."""
+@pytest.mark.parametrize("scatter_impl", ["xla", "xla_sorted"])
+def test_presort_sharded_matches(mesh, scatter_impl):
+    """Presort on a dp x ps mesh.  Plain xla takes the
+    indices_are_sorted promise; xla_sorted skips its per-shard argsort
+    (the dp split of a sorted array is contiguous chunks, so each
+    shard's ids stay ascending with its in-range run contiguous).
+    Results must match the unsorted mesh run, masked lanes included."""
     rng = np.random.default_rng(3)
     num_users, num_items, dim = 64, 96, 8
     logic = OnlineMatrixFactorization(
@@ -135,11 +139,16 @@ def test_presort_sharded_matches(mesh):
     )
     store = ShardedParamStore.create(
         num_items, (dim,), init_fn=normal_factor(0, (dim,)), mesh=mesh,
+        scatter_impl=scatter_impl,
     )
     state0 = logic.init_state(jax.random.PRNGKey(0))
     plain = jax.jit(make_train_step(logic, store.spec))
     sorted_step = jax.jit(make_train_step(logic, store.spec, presort=True))
     b = _batch(rng, 256, num_users, num_items, mask_frac=0.1)
+    # 150 hot lanes: the sorted run of id 7 spans ~[8, 158), STRADDLING
+    # the dp=2 chunk boundary at 128 — the all_gather reassembly must
+    # keep the split run ascending across shards
+    b["item"] = b["item"].at[:150].set(7)
     t_a, s_a, _ = plain(store.table, state0, b)
     t_b, s_b, _ = sorted_step(store.table, state0, b)
     np.testing.assert_allclose(np.asarray(t_a), np.asarray(t_b), atol=2e-5)
