@@ -52,18 +52,24 @@ def sorted_dedup_scatter_add(
 
     ``ids``: (n,) int32, out-of-range values (>= table rows, or >= oob)
     are dropped.  ``deltas``: (n, *value_shape).  ``mask``: optional (n,)
-    bool — masked lanes are dropped (their ids are routed out of bounds,
-    so they cannot even contribute a zero-add to a hot row's segment).
+    bool — masked lanes never change the table.  On the default
+    (unsorted) path their ids are routed out of bounds, so they don't
+    even join a row's segment; under ``ids_sorted=True`` they instead
+    contribute a zero-add to their own row's segment — the zero comes
+    from a ``where``-SELECT of the delta (not a multiply), so even a
+    NaN-poisoned masked delta is inert.
 
     ``ids_sorted=True`` is the caller's PROMISE that ``ids`` is already
-    ascending with any invalid lanes at the END (e.g. a batch pre-sorted
-    by :func:`~..core.transform.make_train_step`'s ``presort`` with
-    negatives routed to the sentinel before sorting) — the argsort +
-    delta permute are skipped, saving two batch-sized HBM passes.  The
-    in-range clamp below maps every id above ``oob`` to exactly ``oob``,
-    which keeps an ascending input ascending, so the
-    ``indices_are_sorted`` promise to XLA stays honest.  Ignored when
-    ``mask`` is given (mask routing moves lanes out of order).
+    ascending (e.g. a batch pre-sorted by
+    :func:`~..core.transform.make_train_step`'s ``presort``) — the
+    argsort + delta permute are skipped, saving two batch-sized HBM
+    passes.  Invalid lanes may sit ANYWHERE: instead of the unsorted
+    path's id re-routing (which would put the ``oob`` sentinel in front
+    of the run and break the order), invalid lanes keep an
+    order-preserving CLIPPED id with their delta zeroed — a numerically
+    inert zero-add — so masked lanes, negatives, and beyond-``oob``
+    tails all stay honest under the ``indices_are_sorted`` promise
+    XLA is given.
     """
     rows = table.shape[0]
     if oob is None:
@@ -83,19 +89,30 @@ def sorted_dedup_scatter_add(
             f"oob + n - 1 = {oob + n - 1} overflows int32 id space"
         )
     ids = ids.astype(jnp.int32)
-    if mask is not None:
-        ids = jnp.where(mask, ids, oob)
-        ids_sorted = False  # mask routing breaks the caller's ordering
-    # Route negatives (would wrap before mode="drop") AND any id beyond
-    # ``oob`` to exactly ``oob``: sorted ids then never exceed ``oob``,
-    # so the empty-slot reps ``oob + slot`` (slot >= 1) cannot collide
-    # with a real segment's rep — the unique_indices promise holds for
-    # arbitrary caller ids.
-    ids = jnp.where((ids < 0) | (ids > oob), oob, ids)
-
     if ids_sorted:
-        sid, sdl = ids, deltas
+        # Order-preserving invalid-lane handling: zero the delta and
+        # CLIP the id (monotone) rather than re-routing it — negatives
+        # become inert zero-adds on row 0, masked lanes zero-adds on
+        # their own row, beyond-oob tails clip to oob and drop.
+        invalid = ids < 0
+        if mask is not None:
+            invalid = invalid | ~mask
+        deltas = jnp.where(
+            invalid.reshape(invalid.shape + (1,) * (deltas.ndim - 1)),
+            jnp.zeros_like(deltas),
+            deltas,
+        )
+        sid = jnp.clip(ids, 0, oob)
+        sdl = deltas
     else:
+        if mask is not None:
+            ids = jnp.where(mask, ids, oob)
+        # Route negatives (would wrap before mode="drop") AND any id
+        # beyond ``oob`` to exactly ``oob``: sorted ids then never
+        # exceed ``oob``, so the empty-slot reps ``oob + slot``
+        # (slot >= 1) cannot collide with a real segment's rep — the
+        # unique_indices promise holds for arbitrary caller ids.
+        ids = jnp.where((ids < 0) | (ids > oob), oob, ids)
         order = jnp.argsort(ids)
         sid = jnp.take(ids, order)
         sdl = jnp.take(deltas, order, axis=0)

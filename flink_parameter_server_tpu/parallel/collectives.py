@@ -113,10 +113,10 @@ def shard_push_add(
     ``ids_sorted=True`` (xla_sorted only): the caller promises GLOBALLY
     ascending flat ids (batch presort).  The dp split is then contiguous
     chunks of a sorted array and the tiled all_gather reassembles them
-    in dp order, so each shard sees ascending ids whose in-range run is
-    contiguous: below-range lanes clip to 0 with zeroed deltas (order-
-    preserving zero-adds) and above-range lanes clip to the oob sentinel
-    — the per-shard argsort + delta permute are skipped entirely.
+    in dp order, so each shard sees ascending ids — the per-shard
+    argsort + delta permute are skipped entirely (the op handles each
+    shard's out-of-range lanes order-preservingly; see
+    :func:`..ops.sorted_scatter.sorted_dedup_scatter_add`).
     """
     value_rank = table.ndim - 1
     if impl == "pallas":
@@ -174,32 +174,17 @@ def shard_push_add(
         if impl == "xla_sorted":
             from ..ops.sorted_scatter import sorted_dedup_scatter_add
 
-            if ids_sorted:
-                # ascending rel: [negatives][this shard's run][>= rows].
-                # Routing misses via the mask would break the order
-                # (oob lands in front), so instead zero their deltas
-                # and clip low lanes to row 0 — ascending survives and
-                # the zero-adds are numerically inert.
-                d = local_deltas.reshape((-1,) + local_table.shape[1:])
-                d = jnp.where(
-                    hit.reshape((-1,) + (1,) * value_rank),
-                    d,
-                    jnp.zeros_like(d),
-                )
-                return sorted_dedup_scatter_add(
-                    local_table,
-                    jnp.clip(rel, 0, rows),
-                    d,
-                    None,
-                    oob=rows,
-                    ids_sorted=True,
-                )
+            # under ids_sorted the op itself keeps invalid lanes
+            # order-preserving (zero-delta + monotone clip) — the
+            # ascending rel = [negatives][this shard's run][>= rows]
+            # needs no caller-side prep
             return sorted_dedup_scatter_add(
                 local_table,
                 rel,
                 local_deltas.reshape((-1,) + local_table.shape[1:]),
                 hit,
                 oob=rows,
+                ids_sorted=ids_sorted,
             )
         rel = jnp.clip(rel, 0, rows - 1)
         d = local_deltas.reshape((-1,) + local_table.shape[1:])

@@ -259,3 +259,22 @@ def test_presort_rejects_multi_pull_keys():
     }]
     with pytest.raises(ValueError, match="1-D store keys"):
         transform_binary(batches, num_features=F, presort=True)
+
+
+def test_sorted_scatter_ids_sorted_handles_mask_and_negatives():
+    """Under ids_sorted the op itself keeps invalid lanes
+    order-preserving: masked lanes and negatives become inert zero-adds,
+    matching the unsorted path's drop semantics exactly."""
+    rng = np.random.default_rng(9)
+    table = jnp.asarray(rng.normal(0, 1, (16, 4)).astype(np.float32))
+    # ascending with negatives in FRONT (clip handles any position now)
+    ids = jnp.asarray([-3, -1, 0, 2, 2, 5, 9, 30, 40], jnp.int32)
+    deltas = jnp.asarray(rng.normal(0, 1, (9, 4)).astype(np.float32))
+    mask = jnp.asarray([True, True, True, False, True, True, False,
+                        True, True])
+    got = sorted_dedup_scatter_add(
+        table, ids, deltas, mask, ids_sorted=True
+    )
+    want = sorted_dedup_scatter_add(table, ids, deltas, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-6)

@@ -121,3 +121,37 @@ def test_bench_pinned_run_ignores_artifact(tmp_path, monkeypatch):
     # the artifact itself is loadable; the pin gate (checked in main)
     # is what must keep it out of a pinned arm's output
     assert bench._load_recent_tpu_artifact() is not None
+
+
+class _FakeTpuJax:
+    @staticmethod
+    def default_backend():
+        return "tpu"
+
+
+def test_measured_defaults_presort_validation(tmp_path, capsys, monkeypatch):
+    """A malformed presort value in chosen_defaults.json must drop the
+    whole measured set with a warning (never silently enable presort);
+    a proper bool rides through to the adopted defaults."""
+    import json as _json
+
+    import bench
+
+    # an ambient variant-knob export would make _measured_defaults
+    # discard the measured set for an unrelated reason
+    for k in ("FPS_BENCH_FUSED", "FPS_BENCH_DIM", "FPS_BENCH_SCATTER",
+              "FPS_BENCH_LAYOUT", "FPS_BENCH_PRESORT"):
+        monkeypatch.delenv(k, raising=False)
+
+    base = {"scatter_impl": "xla_sorted", "layout": "dense",
+            "fused": False, "dim": 64, "batch": 65536}
+
+    p = tmp_path / "chosen_defaults.json"
+    p.write_text(_json.dumps({**base, "presort": "0"}))  # string junk
+    assert bench._measured_defaults(_FakeTpuJax, path=str(p)) == {}
+    assert "malformed" in capsys.readouterr().err
+
+    good = {**base, "presort": True}
+    p.write_text(_json.dumps(good))
+    out = bench._measured_defaults(_FakeTpuJax, path=str(p))
+    assert out == good
