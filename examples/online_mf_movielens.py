@@ -7,6 +7,8 @@ item factors on the sharded PS, train with async-style SGD.
 Usage (ParameterTool-style args — utils/config.py):
     python examples/online_mf_movielens.py [--path ratings-file]
         [--dim 32] [--lr 0.05] [--epochs 3] [--batch 4096]
+        [--scatter xla|pallas|xla_sorted] [--layout dense|packed|auto]
+        [--presort 0|1] [--steps-per-call 1]
 
 Without ``--path`` a synthetic Zipf-skewed MovieLens-like stream is used.
 Runs on whatever devices are available (CPU mesh works:
@@ -57,6 +59,10 @@ def main():
         learning_rate=params.get_float("lr", 0.05),
         mesh=mesh,
         collect_outputs=False,
+        scatter_impl=params.get("scatter", "xla"),
+        layout=params.get("layout", "dense"),
+        presort=params.get_bool("presort", False),
+        steps_per_call=params.get_int("steps-per-call", 1),
     )
     uf = np.asarray(res.worker_state)
     itf = np.asarray(res.store.values())
