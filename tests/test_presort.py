@@ -278,3 +278,50 @@ def test_sorted_scatter_ids_sorted_handles_mask_and_negatives():
     want = sorted_dedup_scatter_add(table, ids, deltas, mask)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-6)
+
+
+def test_presort_derived_push_ids_take_unsorted_path():
+    """A logic that pushes ids DERIVED from the pulled keys (different
+    tracer object) must not inherit the sorted promise — the identity
+    gate falls back to the routed/sorted-inside push and stays correct."""
+    from flink_parameter_server_tpu.core.batched import (
+        BatchedWorkerLogic,
+        PushRequest,
+    )
+
+    class DerivedIdLogic(BatchedWorkerLogic):
+        """Pulls row i, pushes its delta to row (i+1) % cap — a remap
+        the MF identity shortcut cannot see."""
+
+        def __init__(self, cap):
+            self.cap = cap
+
+        def init_state(self, rng):
+            return jnp.zeros((1,), jnp.float32)
+
+        def keys(self, batch):
+            return batch["id"]
+
+        def step(self, state, batch, pulled):
+            push_ids = (batch["id"] + 1) % self.cap  # derived tracer
+            deltas = batch["x"] + 0.1 * pulled
+            return state, PushRequest(push_ids, deltas, batch["mask"]), {}
+
+    cap, dim, n = 32, 4, 64
+    rng = np.random.default_rng(11)
+    logic = DerivedIdLogic(cap)
+    store = ShardedParamStore.create(
+        cap, (dim,), init_fn=normal_factor(0, (dim,)),
+        scatter_impl="xla_sorted",
+    )
+    batch = {
+        "id": jnp.asarray(rng.integers(0, cap, n).astype(np.int32)),
+        "x": jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32)),
+        "mask": jnp.asarray(rng.random(n) >= 0.2),
+    }
+    state0 = logic.init_state(jax.random.PRNGKey(0))
+    plain = jax.jit(make_train_step(logic, store.spec))
+    sorted_step = jax.jit(make_train_step(logic, store.spec, presort=True))
+    t_a, _, _ = plain(store.table, state0, batch)
+    t_b, _, _ = sorted_step(store.table, state0, batch)
+    np.testing.assert_allclose(np.asarray(t_a), np.asarray(t_b), atol=2e-5)
