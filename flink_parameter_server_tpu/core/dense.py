@@ -234,28 +234,83 @@ def transform_dense(
     *,
     batch_sharding=None,
     on_step: Optional[Callable[[int, Array], None]] = None,
+    steps_per_call: int = 1,
 ) -> TransformResult:
     """The ``transform`` loop for the dense case: one jitted
     pull→grad→push per microbatch; returns losses as worker outputs and
-    the final model as the server dump."""
-    step = jax.jit(
-        make_dense_train_step(loss_fn, server.optimizer),
-        donate_argnums=(0, 1),
-    )
+    the final model as the server dump.
+
+    ``steps_per_call=K`` scans K microbatches inside one jitted dispatch
+    (same dispatch-amortization as ``transform_batched``; decisive when
+    host↔device latency rivals the step time).  Per-step losses and
+    ``on_step`` calls are preserved by unstacking; a trailing group
+    shorter than K runs the single-step program.
+    """
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call={steps_per_call}: must be >= 1")
+    from .transform import scan_group_sharding, stack_group
+
+    base = make_dense_train_step(loss_fn, server.optimizer)
+    step = jax.jit(base, donate_argnums=(0, 1))
+    scan_step = None
+    scan_sharding = None
+    if steps_per_call > 1:
+        scan_sharding = scan_group_sharding(batch_sharding)
+
+        def _scan(params, opt_state, batches):
+            def body(carry, b):
+                p, o = carry
+                p, o, loss = base(p, o, b)
+                return (p, o), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), batches
+            )
+            return params, opt_state, losses
+
+        scan_step = jax.jit(_scan, donate_argnums=(0, 1))
+
     # The jitted step donates its (params, opt_state) arguments; start from
     # copies so the caller's server survives (it is a read-only input).
     params = jax.tree.map(jnp_copy, server.params)
     opt_state = jax.tree.map(jnp_copy, server.opt_state)
     losses: List[Any] = []
-    for i, batch in enumerate(data):
+
+    def _run_one(params, opt_state, batch):
         if batch_sharding is not None:
             batch = jax.tree.map(
                 lambda x: jax.device_put(x, batch_sharding), batch
             )
         params, opt_state, loss = step(params, opt_state, batch)
         if on_step is not None:
-            on_step(i, loss)
+            on_step(len(losses), loss)
         losses.append(loss)
+        return params, opt_state
+
+    def _run_group(params, opt_state, group):
+        stacked = stack_group(group, scan_sharding)
+        params, opt_state, group_losses = scan_step(
+            params, opt_state, stacked
+        )
+        for i in range(len(group)):
+            loss = group_losses[i]
+            if on_step is not None:
+                on_step(len(losses), loss)
+            losses.append(loss)
+        return params, opt_state
+
+    group: List[Any] = []
+    for batch in data:
+        if steps_per_call == 1:
+            params, opt_state = _run_one(params, opt_state, batch)
+            continue
+        group.append(batch)
+        if len(group) == steps_per_call:
+            params, opt_state = _run_group(params, opt_state, group)
+            group = []
+    for batch in group:  # tail shorter than K
+        params, opt_state = _run_one(params, opt_state, batch)
+
     final = DenseParameterServer(params, server.optimizer, opt_state)
     return TransformResult(
         worker_outputs=losses,

@@ -367,6 +367,43 @@ def make_train_step(
     return step
 
 
+def scan_group_sharding(batch_sharding):
+    """Sharding for (K, batch, ...)-stacked scan inputs: the scan axis
+    prepends as unsharded, the per-batch spec shifts right.  ``None``
+    passes through; sharding types without a named PartitionSpec are
+    rejected loudly — silently skipping the reshard would strand a
+    dp-sharded caller's data replicated on the default device."""
+    if batch_sharding is None:
+        return None
+    spec = getattr(batch_sharding, "spec", None)
+    if spec is None:
+        raise ValueError(
+            f"steps_per_call > 1 needs a NamedSharding batch sharding "
+            f"(got {type(batch_sharding).__name__}): extending the "
+            f"leading scan axis is only defined for named PartitionSpecs"
+        )
+    return NamedSharding(batch_sharding.mesh, PartitionSpec(None, *spec))
+
+
+def stack_group(group, scan_sharding=None):
+    """Stack K microbatches into (K, ...) leaves for a scanned dispatch.
+
+    Stacks on the HOST (the data iterator yields host arrays — the
+    ingestion edge), then ships each byte exactly once: ``jnp.stack``
+    would commit a replicated default-device copy first and the reshard
+    would move the same bytes a second time.  Device-resident leaves are
+    pulled to the host once (np.asarray) — callers chasing the last
+    transfer should feed host arrays, as the loaders do."""
+    stacked = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *group
+    )
+    if scan_sharding is not None:
+        stacked = jax.tree.map(
+            lambda x: jax.device_put(x, scan_sharding), stacked
+        )
+    return stacked
+
+
 def make_scan_train_step(
     logic: BatchedWorkerLogic,
     spec,
@@ -475,9 +512,9 @@ def transform_batched(
 
     # the scanned program consumes (K, batch, ...) leaves: the dp shard
     # moves to axis 1 (axis 0 is scan time, resident on every device)
-    scan_sharding = None
-    if batch_sharding is not None and steps_per_call > 1:
-        scan_sharding = NamedSharding(mesh, PartitionSpec(None, dp_axis))
+    scan_sharding = (
+        scan_group_sharding(batch_sharding) if steps_per_call > 1 else None
+    )
 
     table = jnp_copy(store.table)
     worker_outputs: List[Any] = []
@@ -498,17 +535,7 @@ def transform_batched(
         return table, state
 
     def _run_group(table, state, group, first_idx):
-        # stack on the HOST (the data iterator yields host arrays — the
-        # ingestion edge), then ship each byte exactly once: jnp.stack
-        # would commit a replicated default-device copy first and the
-        # reshard would move the same bytes a second time
-        stacked = jax.tree.map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *group
-        )
-        if scan_sharding is not None:
-            stacked = jax.tree.map(
-                lambda x: jax.device_put(x, scan_sharding), stacked
-            )
+        stacked = stack_group(group, scan_sharding)
         table, state, outs = scan_step(table, state, stacked)
         if on_step is not None or collect_outputs:
             for i in range(len(group)):

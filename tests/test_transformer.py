@@ -342,3 +342,52 @@ def test_pipelined_ring_attention_gradients():
         g_pp,
         g_dense,
     )
+
+
+@pytest.mark.parametrize("spc", [2, 3])
+def test_transform_dense_steps_per_call_matches(spc):
+    """K dense steps per jitted dispatch (lax.scan) must match the
+    per-dispatch loop per step — losses, final params, tail included."""
+    import numpy as _np
+
+    from flink_parameter_server_tpu.core.dense import transform_dense
+
+    rng = _np.random.default_rng(2)
+    batches = [
+        {"x": jnp.asarray(rng.normal(size=(32, 16)), jnp.float32),
+         "y": jnp.asarray(rng.normal(size=(32, 4)), jnp.float32)}
+        for _ in range(5)  # 5 % spc != 0 -> exercises the tail
+    ]
+
+    import optax
+
+    from flink_parameter_server_tpu.core.dense import DenseParameterServer
+
+    def run(steps_per_call):
+        prng = _np.random.default_rng(0)
+        params = {
+            "w1": jnp.asarray(prng.normal(0, 0.1, (16, 32)), jnp.float32),
+            "b1": jnp.asarray(_np.zeros(32), jnp.float32),
+            "w2": jnp.asarray(prng.normal(0, 0.1, (32, 4)), jnp.float32),
+        }
+        server = DenseParameterServer(params, optax.adam(1e-2))
+
+        def loss_fn(p, batch):
+            h = jnp.tanh(batch["x"] @ p["w1"] + p["b1"])
+            return jnp.mean(((h @ p["w2"]) - batch["y"]) ** 2)
+
+        return transform_dense(
+            batches, loss_fn, server, steps_per_call=steps_per_call
+        )
+
+    a, b = run(1), run(spc)
+    assert len(a.worker_outputs) == len(b.worker_outputs) == 5
+    for la, lb in zip(a.worker_outputs, b.worker_outputs):
+        np.testing.assert_allclose(float(la), float(lb), atol=1e-6)
+    for xa, xb in zip(
+        jax.tree.leaves(a.server_outputs[0]),
+        jax.tree.leaves(b.server_outputs[0]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(xa), np.asarray(xb), atol=1e-6
+        )
