@@ -70,6 +70,12 @@ class DriverConfig:
     # Periodic saves via orbax AsyncCheckpointer: save() returns after the
     # device→host copy, disk writes overlap the next training steps.
     async_checkpoints: bool = False
+    # Batch presort (core/transform.make_train_step): sort each
+    # microbatch by store key on-device before the pull — the HBM
+    # locality lever.  Driver-compatible: metrics count events via the
+    # mask (order-independent) and checkpoints see step boundaries;
+    # only per-record OUTPUT order changes (collect_outputs consumers).
+    presort: bool = False
     # Preemption-safe shutdown (the reference's stop-with-savepoint
     # analogue; Flink jobs drain + savepoint on SIGTERM): on any of
     # these signals the driver stops feeding batches, finishes the
@@ -284,6 +290,7 @@ class StreamingDriver:
                 state_callback=state_callback,
                 initial_state=self._state,
                 skip_batches=skip,
+                presort=cfg.presort,
             )
         except BaseException:
             # The in-flight table/state buffers were donated; leave the

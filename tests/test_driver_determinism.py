@@ -290,3 +290,33 @@ def test_request_stop_programmatic(tmp_path):
     d2 = _driver()
     d2.run(_stream(n=3))
     assert d2.step_idx == 3
+
+
+def test_driver_presort_same_final_model():
+    """DriverConfig(presort=True) must train to the same model as the
+    plain driver on the same stream (f32 tolerance) — the knob rides
+    through run() without disturbing metrics/checkpoint plumbing."""
+    from flink_parameter_server_tpu.utils.initializers import normal_factor
+
+    data = synthetic_ratings(80, 120, 3_000, rank=4, noise=0.01, seed=8)
+
+    def run(presort):
+        logic = OnlineMatrixFactorization(
+            80, 8, updater=SGDUpdater(0.08), seed=0
+        )
+        store = ShardedParamStore.create(
+            120, (8,), init_fn=normal_factor(1, (8,)),
+        )
+        drv = StreamingDriver(
+            logic, store,
+            config=DriverConfig(metrics_every=4, presort=presort),
+        )
+        res = drv.run(microbatches(data, 256, epochs=2, shuffle_seed=0))
+        assert drv.metrics is not None and drv.metrics.total_steps > 0
+        return res
+
+    a, b = run(False), run(True)
+    np.testing.assert_allclose(
+        np.asarray(a.store.values()), np.asarray(b.store.values()),
+        atol=5e-5,
+    )
