@@ -70,17 +70,20 @@ def test_driver_checkpoint_and_resume(tmp_path):
     assert d2.step_idx == 25
 
 
-def test_driver_resume_does_not_double_apply(tmp_path):
+@pytest.mark.parametrize("presort", [False, True])
+def test_driver_resume_does_not_double_apply(tmp_path, presort):
     """Crash-at-step-K resume: re-feeding the same stream must fast-forward
-    past the consumed prefix, reproducing the uninterrupted run exactly."""
+    past the consumed prefix, reproducing the uninterrupted run exactly —
+    with and without presort (the cursor counts BATCHES, which presort
+    does not change)."""
     # uninterrupted oracle
-    d_full = _driver(None)
+    d_full = _driver(None, presort=presort)
     d_full.run(_stream())
     # interrupted run: checkpoint every 10, stop after 10 steps
-    d_a = _driver(tmp_path, checkpoint_every=10)
+    d_a = _driver(tmp_path, checkpoint_every=10, presort=presort)
     stream = list(_stream())
     d_a.run(iter(stream[:10]))  # "crash" right at the checkpoint
-    d_b = _driver(tmp_path)
+    d_b = _driver(tmp_path, presort=presort)
     assert d_b.resume() and d_b.step_idx == 10
     d_b.run(iter(stream))  # SAME stream from the start; driver skips 10
     assert d_b.step_idx == 20
