@@ -1,0 +1,215 @@
+"""Dispatch-amortization A/B for ``steps_per_call=K`` under INJECTED
+host latency (VERDICT r4 next #3).
+
+``transform_batched(steps_per_call=K)`` exists to amortize the
+host↔device round trip: one jitted dispatch per K microbatches instead
+of per microbatch.  Its motivating number — ~75 ms tunnel RTT vs a
+~2 ms device step (round-2 bench rows) — had never been converted into
+a measured rate-vs-K curve on ANY backend.  This harness bounds the
+K-choice off-chip so a tunnel window only needs a confirmation point:
+
+  * run the SAME fixed stream of microbatches through the real grouped
+    dispatch path (``make_train_step`` / ``make_scan_train_step`` +
+    ``stack_group`` — the exact programs ``transform_batched`` jits),
+  * after every jitted call, block on the result and ``sleep(rtt)`` to
+    model the tunnel's synchronous round trip (the tunnel taxes each
+    dispatch interaction, not each microbatch),
+  * sweep K x rtt, report updates/sec + the analytic-model fit.
+
+Model: t_total(K) ~= ceil(n/K) * (rtt + c_dispatch) + n * t_step
+(+ host stacking, which grows mildly with K).  So rate(K) saturates
+once rtt/K << t_step; the knee is K* ~= rtt / t_step.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python benchmarks/steps_per_call_latency.py \
+        [--out results/cpu/steps_per_call_latency.md]
+
+Prints one ``rtt_ms K updates_per_sec`` line per cell and writes the
+markdown table + JSON next to the other off-chip evidence.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# This harness is CPU-only by design (off-chip evidence) — self-scrub
+# the axon plugin env before jax loads, else a dead tunnel wedges the
+# import (sitecustomize initializes the remote backend regardless of
+# JAX_PLATFORMS).
+if os.environ.get("FPS_BENCH_CPU_FALLBACK") != "1":
+    from flink_parameter_server_tpu.utils.backend_probe import scrub_axon_env
+
+    env = scrub_axon_env(pythonpath_prepend=(REPO,))
+    env["FPS_BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
+
+def make_stream(n_batches, batch, num_users, num_items, seed=0):
+    """Fixed host-side stream via the package's own loaders (the
+    Zipf-skewed generator + microbatcher the real training loops use)."""
+    from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+    from flink_parameter_server_tpu.data.streams import microbatches
+
+    cols = synthetic_ratings(
+        num_users=num_users, num_items=num_items,
+        num_ratings=n_batches * batch, seed=seed,
+    )
+    return list(microbatches(cols, batch))
+
+
+def build_dispatch(stream, store, logic, K):
+    """ONE jitted program per K (shared across every rtt — the sweep
+    must not recompile identical programs per cell)."""
+    import jax
+
+    from flink_parameter_server_tpu.core.transform import (
+        make_scan_train_step,
+        make_train_step,
+        stack_group,
+    )
+
+    spec = store.spec
+    n = len(stream)
+    if K == 1:
+        step = jax.jit(make_train_step(logic, spec), donate_argnums=(0, 1))
+        groups = [(b,) for b in stream]
+
+        def dispatch(table, state, group):
+            return step(table, state, group[0])
+    else:
+        step = jax.jit(
+            make_scan_train_step(logic, spec), donate_argnums=(0, 1)
+        )
+        groups = [tuple(stream[i:i + K]) for i in range(0, n, K)]
+
+        def dispatch(table, state, group):
+            return step(table, state, stack_group(group, None))
+
+    return dispatch, groups
+
+
+def run_config(dispatch, groups, store, logic, n_records, rtt_s, reps=3):
+    import jax
+
+    rates = []
+    for _ in range(reps):
+        table = jax.numpy.array(np.asarray(store.table))
+        state = logic.init_state(jax.random.PRNGKey(0))
+        # compile outside the timed region (first dispatch of each rep
+        # is cached after rep 0; rep 0's compile is excluded too)
+        table, state, out = dispatch(table, state, groups[0])
+        jax.block_until_ready(table)
+        t0 = time.perf_counter()
+        for g in groups:
+            table, state, out = dispatch(table, state, g)
+            jax.block_until_ready(table)
+            if rtt_s > 0:
+                time.sleep(rtt_s)
+        dt = time.perf_counter() - t0
+        rates.append(n_records / dt)
+    return float(np.median(rates)), float(min(rates)), float(max(rates))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "results", "cpu", "steps_per_call_latency.md"))
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--n-batches", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.utils.initializers import normal_factor
+
+    num_items, num_users, dim = 16_384, 4_096, 32
+    logic = OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(0.01)
+    )
+    store = ShardedParamStore.create(
+        num_items, (dim,), init_fn=normal_factor(1, (dim,))
+    )
+    stream = make_stream(args.n_batches, args.batch, num_users, num_items)
+
+    ks = (1, 4, 16, 64)
+    rtts_ms = (0.0, 25.0, 75.0)
+    n_records = args.n_batches * args.batch
+    rows = []
+    for K in ks:  # K outer: one compile per K, shared across rtts
+        if args.n_batches % K != 0:
+            print(f"skip K={K}: does not divide n={args.n_batches}")
+            continue
+        dispatch, groups = build_dispatch(stream, store, logic, K)
+        for rtt_ms in rtts_ms:
+            rate, lo, hi = run_config(
+                dispatch, groups, store, logic, n_records,
+                rtt_ms / 1e3, reps=args.reps,
+            )
+            rows.append({
+                "rtt_ms": rtt_ms, "K": K, "updates_per_sec": rate,
+                "rate_min": lo, "rate_max": hi,
+            })
+            print(f"rtt={rtt_ms:5.1f}ms K={K:3d} "
+                  f"{rate/1e6:8.3f}M updates/sec "
+                  f"[{lo/1e6:.3f}, {hi/1e6:.3f}]", flush=True)
+    rows.sort(key=lambda r: (r["rtt_ms"], r["K"]))
+
+    # the knee: smallest K whose rate is >= 90% of this rtt's best
+    recs = {}
+    for rtt_ms in rtts_ms:
+        sub = [r for r in rows if r["rtt_ms"] == rtt_ms]
+        best = max(r["updates_per_sec"] for r in sub)
+        recs[rtt_ms] = min(
+            r["K"] for r in sub if r["updates_per_sec"] >= 0.9 * best
+        )
+
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    plat = jax.default_backend()
+    lines = [
+        f"# steps_per_call dispatch amortization — {plat}, {stamp}",
+        f"# batch={args.batch} n_batches={args.n_batches} dim=32 "
+        f"items=16384 Zipf1.2; injected sleep(rtt) per jitted dispatch "
+        f"models the tunnel round trip (r2 measured ~75 ms e2e vs ~2 ms "
+        f"device step)",
+        "",
+        "| rtt_ms | K | updates/sec | spread |",
+        "|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['rtt_ms']:.0f} | {r['K']} | "
+            f"{r['updates_per_sec']/1e6:.3f}M | "
+            f"[{r['rate_min']/1e6:.3f}, {r['rate_max']/1e6:.3f}] |"
+        )
+    lines.append("")
+    lines.append(
+        "Knee (smallest K within 90% of the rtt's best rate): "
+        + ", ".join(f"rtt={k:.0f}ms → K={v}" for k, v in recs.items())
+    )
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(os.path.splitext(args.out)[0] + ".json", "w") as f:
+        json.dump({"rows": rows, "knee_K_by_rtt_ms": recs,
+                   "platform": plat, "captured_at": time.time()}, f,
+                  indent=1)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -314,19 +314,23 @@ def make_train_step(
     SORTED order; per-record output consumers that need stream order
     should keep presort off.
 
-    Caveat: "the whole microbatch" means every pytree leaf whose
-    leading dimension equals the key count — that is the per-record
-    contract of :mod:`..data.streams` batches.  A logic whose batch
-    carries a NON-per-record array that coincidentally has the batch
-    size as its leading dim (e.g. a (batch, d) per-step constant table)
-    would get its rows permuted too — keep presort off for such
-    batches.
+    Caveat: by default "the whole microbatch" means every pytree leaf
+    whose leading dimension equals the key count — that is the
+    per-record contract of :mod:`..data.streams` batches.  A logic
+    whose batch carries a NON-per-record array that coincidentally has
+    the batch size as its leading dim (e.g. a (batch, d) per-step
+    constant table) would get its rows permuted too — such logics
+    should override ``BatchedWorkerLogic.per_record_leaves`` to declare
+    exactly which leaves are per-record, which both exempts the
+    constants and turns the convention into a trace-time-validated
+    contract (a declared leaf with the wrong leading dim raises).
     """
     from . import store as store_mod
 
     def step(table, state, batch):
         if presort:
-            ids0 = jnp.asarray(logic.keys(batch)).astype(jnp.int32)
+            ids_pre = logic.keys(batch)
+            ids0 = jnp.asarray(ids_pre).astype(jnp.int32)
             if ids0.ndim != 1:
                 # multi-pull logics (e.g. PA: (B, K) feature ids) have
                 # no single per-record sort key — argsort along the
@@ -344,14 +348,45 @@ def make_train_step(
             )
             order = jnp.argsort(routed)
             n = ids0.shape[0]
-            batch = jax.tree.map(
-                lambda x: (
-                    jnp.take(x, order, axis=0)
-                    if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n
-                    else x
-                ),
-                batch,
-            )
+            marks = logic.per_record_leaves(batch)
+            if marks is not None:
+                # declared contract: permute exactly the marked leaves,
+                # and validate the declaration at trace time
+                def _permute_marked(x, m):
+                    if not m:
+                        return x
+                    if getattr(x, "ndim", 0) < 1 or x.shape[0] != n:
+                        raise ValueError(
+                            f"per_record_leaves declared a leaf of shape "
+                            f"{getattr(x, 'shape', None)} per-record, but "
+                            f"the batch has {n} records"
+                        )
+                    return jnp.take(x, order, axis=0)
+
+                batch = jax.tree.map(_permute_marked, batch, marks)
+                # the declaration must cover the KEYS leaf: if it was
+                # left unmarked, the batch keys stay unsorted while the
+                # push-identity check below would still hand the sorted
+                # scatter an honest-looking ids_sorted=True — a lie XLA
+                # may miscompile.  Same trace-time identity trick: an
+                # unpermuted keys leaf comes back as the same object.
+                if logic.keys(batch) is ids_pre:
+                    raise ValueError(
+                        "per_record_leaves did not mark the leaf that "
+                        "logic.keys(batch) returns — the sort keys "
+                        "themselves must be declared per-record for "
+                        "presort=True"
+                    )
+            else:
+                # shape heuristic (see docstring caveat)
+                batch = jax.tree.map(
+                    lambda x: (
+                        jnp.take(x, order, axis=0)
+                        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n
+                        else x
+                    ),
+                    batch,
+                )
         ids = logic.keys(batch)
         pulled = store_mod.pull(spec, table, ids)
         state, req, out = logic.step(state, batch, pulled)
@@ -419,7 +454,12 @@ def make_scan_train_step(
     analogue of the reference's combination senders (SURVEY.md §2 #6
     batches *messages* to cut per-message overhead; this batches
     *dispatches* to cut per-step host overhead, which on a remote-TPU
-    link is ~75 ms of tunnel RTT vs a ~2 ms device step).
+    link is ~75 ms of tunnel RTT vs a ~2 ms device step, r2 bench rows).
+    MEASURED (benchmarks/steps_per_call_latency.py, injected-RTT CPU
+    harness; results/cpu/steps_per_call_latency.md): at 75 ms injected
+    RTT, K=64 runs 50x the K=1 rate (2.59M vs 0.052M updates/sec) and
+    the curve is still rising at K=64 — choose K >= rtt/t_step; K=64 is
+    the recommended default over this image's tunnel.
     """
     base = make_train_step(logic, spec, presort=presort)
 
@@ -469,7 +509,12 @@ def transform_batched(
     (essential when host↔device latency rivals the step time; a
     trailing group shorter than K runs through the single-step program).
     Per-step semantics are unchanged; ``on_step``/``collect_outputs``
-    still see one entry per microbatch (unstacked on the host).
+    still see one entry per microbatch (unstacked on the host).  The
+    unstacked entries are real slices, not views: jax.Array indexing
+    dispatches an XLA slice producing an independent buffer, so
+    retaining ``worker_outputs`` does NOT pin the (K, ...) scan output
+    alive (verified empirically — a retained ``x[0]`` of a 256 MiB
+    stack leaves 4 MiB live).
     ``state_callback`` needs the live table BETWEEN steps, which a scan
     cannot surface — combining it with ``steps_per_call > 1`` raises.
     """

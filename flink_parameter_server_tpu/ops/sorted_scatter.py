@@ -60,16 +60,20 @@ def sorted_dedup_scatter_add(
     NaN-poisoned masked delta is inert.
 
     ``ids_sorted=True`` is the caller's PROMISE that ``ids`` is already
-    ascending (e.g. a batch pre-sorted by
+    ascending **as given** (e.g. a batch pre-sorted by
     :func:`~..core.transform.make_train_step`'s ``presort``) — the
     argsort + delta permute are skipped, saving two batch-sized HBM
-    passes.  Invalid lanes may sit ANYWHERE: instead of the unsorted
-    path's id re-routing (which would put the ``oob`` sentinel in front
-    of the run and break the order), invalid lanes keep an
-    order-preserving CLIPPED id with their delta zeroed — a numerically
-    inert zero-add — so masked lanes, negatives, and beyond-``oob``
-    tails all stay honest under the ``indices_are_sorted`` promise
-    XLA is given.
+    passes.  "Ascending as given" includes any negative ids: they must
+    sit at the FRONT of the array, because the invalid-lane handling
+    below clips them to row 0 and a negative anywhere else would clip
+    non-monotonically — making the ``indices_are_sorted`` assertion to
+    XLA a lie it is allowed to miscompile.  Sentinel-routed arrays from
+    this package's push path satisfy the precondition automatically:
+    the routing sentinel is >= every valid id, so routed lanes sort to
+    the END and the array stays ascending.  Do NOT pass a raw
+    "negatives at the end" array directly.  Masked lanes and
+    beyond-``oob`` tails are safe anywhere (zeroed delta + monotone
+    clip keeps them inert and in order).
     """
     rows = table.shape[0]
     if oob is None:

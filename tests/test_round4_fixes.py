@@ -64,6 +64,7 @@ def test_sorted_scatter_rejects_int32_rep_overflow():
         )
 
 
+@pytest.mark.slow
 def test_bench_main_replays_fresh_tpu_artifact(tmp_path):
     """End-to-end: dead tunnel at snapshot time + fresh artifact from
     this round's window -> bench.py prints the artifact payload with

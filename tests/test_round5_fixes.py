@@ -1,0 +1,268 @@
+"""Round-5 regression tests: the enforceable presort per-record-leaf
+contract (VERDICT r4 weak #6) and the self-extending tunnel watcher
+(VERDICT r4 next #8).  All fast-tier: mocks and tiny shapes only."""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from flink_parameter_server_tpu.core.batched import (  # noqa: E402
+    BatchedWorkerLogic,
+    PushRequest,
+)
+from flink_parameter_server_tpu.core.store import (  # noqa: E402
+    ShardedParamStore,
+)
+from flink_parameter_server_tpu.core.transform import (  # noqa: E402
+    make_train_step,
+)
+
+
+class _ConstCarryingLogic(BatchedWorkerLogic):
+    """Batch carries a (batch, d) PER-STEP CONSTANT leaf ("const") whose
+    leading dim coincidentally equals the record count — the documented
+    trap of the shape-based presort heuristic."""
+
+    def __init__(self, declare: bool):
+        self.declare = declare
+
+    def init_state(self, rng):
+        return jnp.zeros(())
+
+    def keys(self, batch):
+        return batch["item"]
+
+    def per_record_leaves(self, batch):
+        if not self.declare:
+            return None
+        return {"item": True, "rating": True, "const": False}
+
+    def step(self, state, batch, pulled):
+        req = PushRequest(
+            ids=batch["item"],
+            deltas=jnp.ones_like(pulled) * batch["rating"][:, None],
+        )
+        # surface the const leaf AS SEEN INSIDE the step so the test can
+        # check whether presort permuted it
+        return state, req, batch["const"]
+
+
+def _run(declare: bool):
+    n, dim = 8, 4
+    store = ShardedParamStore.create(16, (dim,))
+    logic = _ConstCarryingLogic(declare)
+    step = make_train_step(logic, store.spec, presort=True)
+    # descending ids -> presort WILL permute (reversal), making a
+    # wrongly-permuted const observable
+    batch = {
+        "item": jnp.arange(n - 1, -1, -1, dtype=jnp.int32),
+        "rating": jnp.ones(n, jnp.float32),
+        "const": jnp.arange(n * dim, dtype=jnp.float32).reshape(n, dim),
+    }
+    _, _, const_seen = jax.jit(step)(store.table, logic.init_state(None), batch)
+    return np.asarray(batch["const"]), np.asarray(const_seen)
+
+
+def test_presort_heuristic_permutes_coincident_leaf():
+    """The documented trap is real: without a declaration the heuristic
+    permutes the (batch, d) constant."""
+    const, seen = _run(declare=False)
+    assert not np.array_equal(const, seen)
+    assert np.array_equal(const[::-1], seen)  # reversed ids -> reversed
+
+
+def test_presort_declared_leaves_exempt_constant():
+    """Declaring per_record_leaves exempts the constant from the
+    permutation — the contract replaces the heuristic."""
+    const, seen = _run(declare=True)
+    assert np.array_equal(const, seen)
+
+
+def test_presort_declared_leaves_must_mark_keys_leaf():
+    """Forgetting to mark the keys leaf would leave ids unsorted while
+    push still saw an honest-looking ids_sorted=True (trace-time
+    identity) — the contract rejects the declaration instead."""
+
+    class _Forgot(_ConstCarryingLogic):
+        def per_record_leaves(self, batch):
+            return {"item": False, "rating": True, "const": False}
+
+    n, dim = 8, 4
+    store = ShardedParamStore.create(16, (dim,))
+    logic = _Forgot(declare=True)
+    step = make_train_step(logic, store.spec, presort=True)
+    batch = {
+        "item": jnp.arange(n - 1, -1, -1, dtype=jnp.int32),
+        "rating": jnp.ones(n, jnp.float32),
+        "const": jnp.zeros((n, dim)),
+    }
+    with pytest.raises(ValueError, match="keys"):
+        jax.jit(step)(store.table, logic.init_state(None), batch)
+
+
+def test_presort_declared_leaf_wrong_dim_raises():
+    class _Bad(_ConstCarryingLogic):
+        def per_record_leaves(self, batch):
+            # declares the (n, d) const per-record too, but with a LYING
+            # shape below
+            return {"item": True, "rating": True, "const": True}
+
+    n, dim = 8, 4
+    store = ShardedParamStore.create(16, (dim,))
+    logic = _Bad(declare=True)
+    step = make_train_step(logic, store.spec, presort=True)
+    batch = {
+        "item": jnp.arange(n, dtype=jnp.int32),
+        "rating": jnp.ones(n, jnp.float32),
+        "const": jnp.zeros((n + 1, dim)),  # wrong leading dim
+    }
+    with pytest.raises(ValueError, match="per_record_leaves"):
+        jax.jit(step)(store.table, logic.init_state(None), batch)
+
+
+# ---------------------------------------------------------------------------
+# Self-extending tunnel watcher
+# ---------------------------------------------------------------------------
+
+
+def _run_watcher(monkeypatch, tmp_path, probe_results, call_rcs,
+                 argv=("tunnel_watch.py",)):
+    """Drive tunnel_watch.main with scripted probe results and
+    subprocess rcs; returns (rc, calls) where calls is the list of
+    script basenames invoked."""
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    import tunnel_watch
+
+    from flink_parameter_server_tpu.utils import backend_probe
+
+    probes = iter(probe_results)
+    monkeypatch.setattr(
+        backend_probe, "probe_backend",
+        lambda *a, **k: next(probes),
+    )
+    rcs = iter(call_rcs)
+    calls = []
+
+    def fake_call(cmd, **kw):
+        calls.append(os.path.basename(cmd[1]))
+        return next(rcs)
+
+    monkeypatch.setattr(tunnel_watch.subprocess, "call", fake_call)
+    monkeypatch.setattr(tunnel_watch.time, "sleep", lambda s: None)
+    monkeypatch.setattr(tunnel_watch, "OUT_DIR", str(tmp_path))
+    monkeypatch.setattr(sys, "argv", list(argv))
+    return tunnel_watch.main(), calls
+
+
+def test_watcher_rearms_after_failed_smoke_and_truncated_battery(
+    monkeypatch, tmp_path
+):
+    """dead probe -> live+smoke-fail -> live+battery-truncated -> live+
+    battery-ok: one watcher process rides through all of it (r4 needed a
+    human restart)."""
+    rc, calls = _run_watcher(
+        monkeypatch, tmp_path,
+        probe_results=[
+            (False, "unresponsive"),
+            (True, "ok"),   # attempt 1: smoke fails
+            (True, "ok"),   # attempt 2: smoke ok, battery truncated
+            (True, "ok"),   # attempt 3: all green
+        ],
+        call_rcs=[
+            1,              # smoke fail (attempt 1)
+            0, 1, 0,        # smoke ok, battery rc=1, analyze (attempt 2)
+            0, 0, 0,        # smoke ok, battery rc=0, analyze (attempt 3)
+        ],
+    )
+    assert rc == 0
+    assert calls == [
+        "kernel_smoke.py",
+        "kernel_smoke.py", "tpu_day1.py", "analyze_day1.py",
+        "kernel_smoke.py", "tpu_day1.py", "analyze_day1.py",
+    ]
+
+
+def test_watcher_gives_up_at_max_consecutive_smoke_fails(
+    monkeypatch, tmp_path
+):
+    rc, calls = _run_watcher(
+        monkeypatch, tmp_path,
+        probe_results=[(True, "ok")] * 3,
+        call_rcs=[1, 1, 1],  # smoke fails every attempt
+        argv=("tunnel_watch.py", "--max-attempts", "3"),
+    )
+    assert rc == 3
+    assert calls == ["kernel_smoke.py"] * 3
+
+
+def test_watcher_smoke_fails_do_not_exhaust_battery_budget(
+    monkeypatch, tmp_path
+):
+    """Transient mid-smoke tunnel deaths are counted separately from
+    battery attempts, and a passing smoke resets the consecutive-fail
+    count — so fail,fail,pass... days later ...fail,fail,pass still
+    completes."""
+    rc, calls = _run_watcher(
+        monkeypatch, tmp_path,
+        probe_results=[(True, "ok")] * 6,
+        call_rcs=[
+            1,        # smoke fail 1
+            1,        # smoke fail 2
+            0, 1, 0,  # smoke pass (resets), battery truncated, analyze
+            1,        # smoke fail 1 (fresh count)
+            1,        # smoke fail 2
+            0, 0, 0,  # smoke pass, battery ok, analyze
+        ],
+        argv=("tunnel_watch.py", "--max-attempts", "3"),
+    )
+    assert rc == 0
+    assert calls.count("tpu_day1.py") == 2
+
+
+def test_watcher_removes_stale_stop_file_at_startup(monkeypatch, tmp_path):
+    """A stop-file left over from a previous run must not make a fresh
+    watcher exit rc=0 instantly (that would silently lose the round's
+    coverage) — it is removed and watching proceeds."""
+    (tmp_path / "watch.stop").write_text("")
+    rc, calls = _run_watcher(
+        monkeypatch, tmp_path,
+        probe_results=[(True, "ok")],
+        call_rcs=[0, 0, 0],  # smoke, battery, analyze all pass
+    )
+    assert rc == 0
+    assert calls == ["kernel_smoke.py", "tpu_day1.py", "analyze_day1.py"]
+    assert not (tmp_path / "watch.stop").exists()
+
+
+def test_watcher_stop_file_mid_run_exits_cleanly(monkeypatch, tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    import tunnel_watch
+
+    from flink_parameter_server_tpu.utils import backend_probe
+
+    monkeypatch.setattr(
+        backend_probe, "probe_backend",
+        lambda *a, **k: (False, "unresponsive"),
+    )
+    calls = []
+    monkeypatch.setattr(
+        tunnel_watch.subprocess, "call",
+        lambda cmd, **kw: calls.append(cmd) or 0,
+    )
+
+    def sleep_then_stop(s):
+        (tmp_path / "watch.stop").write_text("")
+
+    monkeypatch.setattr(tunnel_watch.time, "sleep", sleep_then_stop)
+    monkeypatch.setattr(tunnel_watch, "OUT_DIR", str(tmp_path))
+    monkeypatch.setattr(sys, "argv", ["tunnel_watch.py"])
+    # rc=4, not 0: an operator abort must not look like a completed
+    # battery to rc-gating automation
+    assert tunnel_watch.main() == 4
+    assert calls == []
+    assert not (tmp_path / "watch.stop").exists()
