@@ -125,6 +125,41 @@ def test_presort_declared_leaf_wrong_dim_raises():
         jax.jit(step)(store.table, logic.init_state(None), batch)
 
 
+def test_presort_declared_leaves_through_transform_batched():
+    """User journey: the declared contract survives the public loop with
+    presort + steps_per_call (scan) combined — consts unpermuted, keys
+    sorted, in every per-step output including the scan-unstacked ones."""
+    from flink_parameter_server_tpu.core.transform import transform_batched
+
+    class _TupleOut(_ConstCarryingLogic):
+        def step(self, state, batch, pulled):
+            state, req, c = super().step(state, batch, pulled)
+            return state, req, (batch["item"], c)
+
+    n, dim = 16, 4
+    store = ShardedParamStore.create(64, (dim,))
+    logic = _TupleOut(declare=True)
+    rng = np.random.default_rng(0)
+    const = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    batches = [
+        {
+            "item": rng.integers(0, 64, n).astype(np.int32),
+            "rating": np.ones(n, np.float32),
+            "const": const,
+        }
+        for _ in range(6)
+    ]
+    res = transform_batched(
+        batches, logic, store, presort=True, steps_per_call=2,
+        dump_model=False,
+    )
+    outs = [o for o in res.worker_outputs if o is not None]
+    assert len(outs) == 6
+    for items, c in outs:
+        assert np.array_equal(np.asarray(c), const)
+        assert np.all(np.diff(np.asarray(items)) >= 0)
+
+
 # ---------------------------------------------------------------------------
 # Self-extending tunnel watcher
 # ---------------------------------------------------------------------------
