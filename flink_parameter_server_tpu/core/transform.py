@@ -487,6 +487,9 @@ def transform_batched(
     dump_model: bool = True,
     on_step: Optional[Callable[[int, Any], None]] = None,
     state_callback: Optional[Callable[[int, Any, Any, Any], None]] = None,
+    group_callback: Optional[
+        Callable[[int, int, Any, Any, Any], None]
+    ] = None,
     initial_state: Any = None,
     skip_batches: int = 0,
     presort: bool = False,
@@ -517,6 +520,18 @@ def transform_batched(
     stack leaves 4 MiB live).
     ``state_callback`` needs the live table BETWEEN steps, which a scan
     cannot surface — combining it with ``steps_per_call > 1`` raises.
+
+    ``group_callback(first_step_idx, n_steps, table, state, outs)`` is
+    the GROUP-granular sibling: it fires once per jitted dispatch (any
+    ``steps_per_call``) with the live (donated-next-dispatch)
+    table/state and the dispatch's RAW output — the single step's
+    ``out`` when ``n_steps == 1``, the (K, ...)-stacked scan output
+    otherwise (no forced host unstacking; finiteness checks and other
+    whole-group reductions work on either form).  This is what lets the
+    StreamingDriver run with ``steps_per_call > 1``: checkpoint / NaN /
+    metrics cadence rounds up to dispatch boundaries — the honest
+    granularity, since between scanned steps there is no host-visible
+    table at all.
     """
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     spec = store.spec
@@ -575,6 +590,8 @@ def transform_batched(
             on_step(step_idx, out)
         if state_callback is not None:
             state_callback(step_idx, table, state, out)
+        if group_callback is not None:
+            group_callback(step_idx, 1, table, state, out)
         if collect_outputs:
             worker_outputs.append(out)
         return table, state
@@ -589,6 +606,10 @@ def transform_batched(
                     on_step(first_idx + i, out_i)
                 if collect_outputs:
                     worker_outputs.append(out_i)
+        if group_callback is not None:
+            # raw stacked outs — whole-group reductions (finiteness) are
+            # cheaper on the stack than on K unstacked slices
+            group_callback(first_idx, len(group), table, state, outs)
         return table, state
 
     group: List[Any] = []

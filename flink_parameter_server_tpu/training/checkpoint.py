@@ -246,6 +246,11 @@ class JobCheckpointManager:
         self.wait()
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Durable (retained) checkpoint steps, ascending."""
+        self.wait()
+        return sorted(self._mgr.all_steps())
+
     def restore_latest(
         self, spec: StoreSpec, worker_state_shardings: Any = None
     ) -> Optional[Tuple[ShardedParamStore, Any, Dict[str, Any]]]:

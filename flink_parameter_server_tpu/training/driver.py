@@ -76,6 +76,16 @@ class DriverConfig:
     # mask (order-independent) and checkpoints see step boundaries;
     # only per-record OUTPUT order changes (collect_outputs consumers).
     presort: bool = False
+    # K microbatches per jitted dispatch (core/transform lax.scan path):
+    # one host round trip per K steps — measured 50x at the tunnel's
+    # 75 ms RTT (results/cpu/steps_per_call_latency.md; use K=64 over a
+    # remote chip).  The driver runs its envelope at DISPATCH
+    # granularity, the honest unit — between scanned steps there is no
+    # host-visible table: checkpoint/nan/metrics cadences round UP to
+    # the next group boundary (a cadence of 10 with K=4 fires at steps
+    # 12, 20, 24, ...), metrics latency percentiles time dispatches (K
+    # steps each), and the profile window covers whole dispatches.
+    steps_per_call: int = 1
     # Preemption-safe shutdown (the reference's stop-with-savepoint
     # analogue; Flink jobs drain + savepoint on SIGTERM): on any of
     # these signals the driver stops feeding batches, finishes the
@@ -198,51 +208,77 @@ class StreamingDriver:
         trace_ctx = {"cm": None}
         first_step_of_run = [True]
 
-        def state_callback(i, table, state, out):
-            global_step = start_step - skip + i + 1
-            events = event_counts.popleft() if event_counts else 0
+        def group_callback(first_idx, n_steps, table, state, outs):
+            # One invocation per jitted DISPATCH (n_steps == 1 when
+            # steps_per_call == 1 — then this is exactly the old
+            # per-step state_callback; n_steps == K for scanned groups,
+            # where cadences round up to the boundary: between scanned
+            # steps there is no host-visible table to act on).
+            prev_global = start_step - skip + first_idx
+            global_step = prev_global + n_steps
+            events = sum(
+                event_counts.popleft() if event_counts else 0
+                for _ in range(n_steps)
+            )
             if self.metrics is None:
-                self.metrics = StepMetrics(events_per_step=events)
+                self.metrics = StepMetrics(
+                    events_per_step=events // max(1, n_steps)
+                )
             if first_step_of_run[0]:
-                # this run's step 0 start was never timestamped (and any
-                # previous run's dangling step_start would fold inter-run
-                # idle time into the latency window) — count, don't time
+                # this run's first dispatch start was never timestamped
+                # (and any previous run's dangling step_start would fold
+                # inter-run idle time into the latency window) — count,
+                # don't time
                 first_step_of_run[0] = False
-                self.metrics.total_steps += 1
+                self.metrics.total_steps += n_steps
                 self.metrics.total_events += events
                 self.metrics.step_start()
             else:
                 if sync_steps:
-                    jax.block_until_ready(out)
-                self.metrics.step_end(events)
+                    jax.block_until_ready(outs)
+                # latency percentiles time DISPATCHES (n_steps steps
+                # each); totals still count steps and events exactly
+                self.metrics.step_end(events, n_steps=n_steps)
                 self.metrics.step_start()
             self.step_idx = global_step
-            if cfg.profile_dir and global_step - start_step == cfg.profile_steps[0]:
+
+            def crossed(every):
+                # did (prev_global, global_step] cross a multiple of
+                # `every`?  == the old `global_step % every == 0` when
+                # n_steps == 1
+                return every and (global_step // every) > (prev_global // every)
+
+            if (
+                cfg.profile_dir
+                and trace_ctx["cm"] is None
+                and not trace_ctx.get("done")
+                and global_step - start_step >= cfg.profile_steps[0]
+            ):
                 trace_ctx["cm"] = profile_trace(cfg.profile_dir)
                 trace_ctx["cm"].__enter__()
-            if (
+            elif (
                 trace_ctx["cm"] is not None
-                and global_step - start_step == cfg.profile_steps[1]
+                and global_step - start_step >= cfg.profile_steps[1]
             ):
                 trace_ctx["cm"].__exit__(None, None, None)
                 trace_ctx["cm"] = None
-            is_ckpt_step = (
-                cfg.checkpoint_every and global_step % cfg.checkpoint_every == 0
-            )
-            if cfg.nan_check_every and (
-                global_step % cfg.nan_check_every == 0 or is_ckpt_step
+                trace_ctx["done"] = True
+            is_ckpt_step = crossed(cfg.checkpoint_every)
+            if crossed(cfg.nan_check_every) or (
+                cfg.nan_check_every and is_ckpt_step
             ):
                 # check table+state too (outputs may carry no floats), as
                 # ONE fused device reduction + a single host transfer;
                 # always check on checkpoint steps so a poisoned table is
-                # never persisted as the "recovery" point
-                if not bool(_all_finite(out, table, state)):
+                # never persisted as the "recovery" point.  `outs` may be
+                # (K, ...)-stacked — the reduction covers every step.
+                if not bool(_all_finite(outs, table, state)):
                     raise TrainingDiverged(
                         f"non-finite step output/params at step {global_step}"
                     )
-            if cfg.metrics_every and global_step % cfg.metrics_every == 0:
+            if crossed(cfg.metrics_every):
                 self.metrics.emit(self.metrics_sink)
-            if cfg.checkpoint_every and global_step % cfg.checkpoint_every == 0:
+            if is_ckpt_step:
                 # Save straight from the live buffers WITHOUT stashing them
                 # on self: the next jitted step donates (deletes) them, and
                 # self.store must never hold a deleted array.  Both save
@@ -287,10 +323,11 @@ class StreamingDriver:
                 rng=self.rng,
                 collect_outputs=collect_outputs,
                 dump_model=cfg.dump_model,
-                state_callback=state_callback,
+                group_callback=group_callback,
                 initial_state=self._state,
                 skip_batches=skip,
                 presort=cfg.presort,
+                steps_per_call=cfg.steps_per_call,
             )
         except BaseException:
             # The in-flight table/state buffers were donated; leave the

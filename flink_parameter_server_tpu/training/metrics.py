@@ -29,6 +29,7 @@ class StepMetrics:
     events_per_step: int
     window: int = 100
     _durations: List[float] = field(default_factory=list)
+    _window_events: List[int] = field(default_factory=list)
     _t_last: Optional[float] = None
     total_steps: int = 0
     total_events: int = 0
@@ -37,21 +38,30 @@ class StepMetrics:
     def step_start(self) -> None:
         self._t_last = time.perf_counter()
 
-    def step_end(self, events: Optional[int] = None) -> None:
-        """``events`` overrides the per-step event count (e.g. a padded
-        final batch contributes only its masked-in rows)."""
+    def step_end(
+        self, events: Optional[int] = None, *, n_steps: int = 1
+    ) -> None:
+        """``events`` overrides the event count for the timed interval
+        (e.g. a padded final batch contributes only its masked-in rows).
+        ``n_steps`` > 1 records one GROUP dispatch covering that many
+        steps (``transform_batched(steps_per_call=K)``): one duration
+        entry — the latency percentiles then time dispatches — while
+        step/event totals and the rate stay exact."""
         assert self._t_last is not None, "step_start() not called"
+        n_events = self.events_per_step * n_steps if events is None else events
         self._durations.append(time.perf_counter() - self._t_last)
+        self._window_events.append(n_events)
         if len(self._durations) > self.window:
             self._durations.pop(0)
-        self.total_steps += 1
-        self.total_events += self.events_per_step if events is None else events
+            self._window_events.pop(0)
+        self.total_steps += n_steps
+        self.total_events += n_events
 
     # -- reporting --------------------------------------------------------
     def updates_per_sec(self) -> float:
         if not self._durations:
             return 0.0
-        return self.events_per_step * len(self._durations) / sum(self._durations)
+        return sum(self._window_events) / sum(self._durations)
 
     def latency_percentiles(self) -> Dict[str, float]:
         if not self._durations:
