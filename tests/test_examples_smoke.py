@@ -1,8 +1,8 @@
 """Smoke tests for the runnable examples (the reference's L5 apps).
 
 Runs the examples as real subprocesses — the exact user surface — so
-example bit-rot fails CI.  All seven examples are covered: the six fast
-ones per-commit, the slow one (hybrid_migration, ~2.5 min on this
+example bit-rot fails CI.  All eight examples are covered: the seven
+fast ones per-commit, the slow one (hybrid_migration, ~2.5 min on this
 1-core host) behind ``FPS_ALL_EXAMPLES=1`` so per-commit cost stays low
 while the verify workflow exercises the full set.
 """
@@ -71,6 +71,19 @@ def test_transformer_lm_example():
     r = _run([os.path.join("examples", "transformer_lm.py")])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "loss" in r.stdout
+
+
+def test_production_driver_example():
+    r = _run(
+        [
+            os.path.join("examples", "production_driver.py"),
+            "--batches", "24", "--steps-per-call", "4",
+            "--checkpoint-every", "8",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "resumed at step" in r.stdout
+    assert "resumed-run RMSE" in r.stdout
 
 
 @pytest.mark.skipif(
