@@ -88,6 +88,56 @@ def test_driver_k_resume_matches_uninterrupted(tmp_path, k):
     )
 
 
+def test_driver_k4_async_checkpoints_match_sync(tmp_path):
+    """Async saves from group boundaries are donation-safe and durable —
+    same resume state as sync mode."""
+    d_sync = _driver(tmp_path / "sync", checkpoint_every=8,
+                     steps_per_call=4)
+    d_sync.run(_stream())
+    d_async = _driver(tmp_path / "async", checkpoint_every=8,
+                      steps_per_call=4, async_checkpoints=True)
+    d_async.run(_stream())
+    r_sync = _driver(tmp_path / "sync")
+    r_async = _driver(tmp_path / "async")
+    assert r_sync.resume() and r_async.resume()
+    assert r_sync.step_idx == r_async.step_idx == 20
+    np.testing.assert_array_equal(
+        np.asarray(r_sync.store.values()),
+        np.asarray(r_async.store.values()),
+    )
+
+
+def test_driver_k4_request_stop_drains_and_checkpoints(tmp_path):
+    """Preemption under grouped dispatch: stop after the next group
+    boundary, drain (tail may run as single steps), close-time save."""
+    d = _driver(tmp_path, checkpoint_every=100, steps_per_call=4)
+    stream = list(_stream())
+
+    def stopping():
+        for i, b in enumerate(stream):
+            if i == 9:
+                d.request_stop()
+            yield b
+        raise AssertionError("stop was ignored — stream exhausted")
+
+    d.run(stopping())
+    # stopped partway: cursor < 20, and the close-time save is durable
+    assert 0 < d.step_idx < 20
+    assert d._ckpt_mgr.latest_step() == d.step_idx
+    # resume + same stream completes the job exactly
+    d2 = _driver(tmp_path, steps_per_call=4)
+    assert d2.resume()
+    d2.run(iter(stream))
+    assert d2.step_idx == 20
+    d_full = _driver(None, steps_per_call=4)
+    d_full.run(iter(stream))
+    np.testing.assert_allclose(
+        np.asarray(d2.store.values()),
+        np.asarray(d_full.store.values()),
+        atol=1e-6,
+    )
+
+
 def test_driver_k4_nan_guard_fires_at_group_boundary(tmp_path):
     """A NaN injected at step 8 (inside the second group) is caught at
     that group's boundary and rolls back to the last durable save."""
