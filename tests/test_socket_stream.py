@@ -110,6 +110,42 @@ def test_parse_reserved_mask_key_is_loud():
         list(it)
 
 
+def test_batcher_invariants_property():
+    """Hypothesis: for ANY mix of valid/malformed records and any batch
+    size — total masked-in lanes == valid records, .dropped == malformed
+    records, every batch is exactly batch_size wide (static shapes),
+    and record order/values survive."""
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=60, deadline=None)
+    @given(
+        st.lists(
+            st.one_of(
+                st.integers(min_value=0, max_value=999),  # valid payload
+                st.just(None),                            # malformed
+            ),
+            min_size=0, max_size=40,
+        ),
+        st.integers(min_value=1, max_value=9),
+    )
+    def prop(records, batch_size):
+        def parse(rec):
+            return {"v": np.int32(rec)}  # None -> TypeError -> dropped
+
+        it = batches_from_records(iter(records), batch_size, parse)
+        batches = list(it)
+        valid = [r for r in records if r is not None]
+        assert it.dropped == len(records) - len(valid)
+        assert all(b["v"].shape == (batch_size,) for b in batches)
+        assert sum(int(b["mask"].sum()) for b in batches) == len(valid)
+        got = [
+            int(v) for b in batches for v, m in zip(b["v"], b["mask"]) if m
+        ]
+        assert got == valid  # order and values survive the bridge
+
+    prop()
+
+
 def test_socket_stream_to_train_step_end_to_end():
     """Full edge: TCP lines -> parse -> microbatches -> jitted PS step.
     The padded tail's masked lanes (pad id 0) must not touch the table:
