@@ -210,15 +210,16 @@ def test_watcher_rearms_after_failed_smoke_and_truncated_battery(
         ],
         call_rcs=[
             1,              # smoke fail (attempt 1)
-            0, 1, 0,        # smoke ok, battery rc=1, analyze (attempt 2)
-            0, 0, 0,        # smoke ok, battery rc=0, analyze (attempt 3)
+            0, 0, 1, 0,     # smoke, first-window bench, battery rc=1,
+                            # analyze (attempt 2)
+            0, 0, 0, 0,     # smoke, bench, battery rc=0, analyze
         ],
     )
     assert rc == 0
     assert calls == [
         "kernel_smoke.py",
-        "kernel_smoke.py", "tpu_day1.py", "analyze_day1.py",
-        "kernel_smoke.py", "tpu_day1.py", "analyze_day1.py",
+        "kernel_smoke.py", "bench.py", "tpu_day1.py", "analyze_day1.py",
+        "kernel_smoke.py", "bench.py", "tpu_day1.py", "analyze_day1.py",
     ]
 
 
@@ -246,17 +247,19 @@ def test_watcher_smoke_fails_do_not_exhaust_battery_budget(
         monkeypatch, tmp_path,
         probe_results=[(True, "ok")] * 6,
         call_rcs=[
-            1,        # smoke fail 1
-            1,        # smoke fail 2
-            0, 1, 0,  # smoke pass (resets), battery truncated, analyze
-            1,        # smoke fail 1 (fresh count)
-            1,        # smoke fail 2
-            0, 0, 0,  # smoke pass, battery ok, analyze
+            1,           # smoke fail 1
+            1,           # smoke fail 2
+            0, 0, 1, 0,  # smoke pass (resets), bench, battery
+                         # truncated, analyze
+            1,           # smoke fail 1 (fresh count)
+            1,           # smoke fail 2
+            0, 0, 0, 0,  # smoke pass, bench, battery ok, analyze
         ],
         argv=("tunnel_watch.py", "--max-attempts", "3"),
     )
     assert rc == 0
     assert calls.count("tpu_day1.py") == 2
+    assert calls.count("bench.py") == 2
 
 
 def test_watcher_removes_stale_stop_file_at_startup(monkeypatch, tmp_path):
@@ -267,10 +270,11 @@ def test_watcher_removes_stale_stop_file_at_startup(monkeypatch, tmp_path):
     rc, calls = _run_watcher(
         monkeypatch, tmp_path,
         probe_results=[(True, "ok")],
-        call_rcs=[0, 0, 0],  # smoke, battery, analyze all pass
+        call_rcs=[0, 0, 0, 0],  # smoke, bench, battery, analyze
     )
     assert rc == 0
-    assert calls == ["kernel_smoke.py", "tpu_day1.py", "analyze_day1.py"]
+    assert calls == ["kernel_smoke.py", "bench.py", "tpu_day1.py",
+                     "analyze_day1.py"]
     assert not (tmp_path / "watch.stop").exists()
 
 
