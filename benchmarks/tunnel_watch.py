@@ -51,6 +51,18 @@ def log(f, msg):
     f.flush()
 
 
+def run_to_file(argv, out_path, timeout):
+    """Launch a job with stdout+stderr to ``out_path``; -1 on timeout."""
+    with open(out_path, "w") as out:
+        try:
+            return subprocess.call(
+                argv, stdout=out, stderr=subprocess.STDOUT,
+                timeout=timeout, cwd=REPO,
+            )
+        except subprocess.TimeoutExpired:
+            return -1
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=int, default=300)
@@ -103,17 +115,11 @@ def main():
                    f"(smoke fails so far: {smoke_fails}, battery "
                    f"attempts: {battery_attempts}/{args.max_attempts})")
             smoke_out = os.path.join(OUT_DIR, "kernel_smoke.out")
-            with open(smoke_out, "w") as so:
-                try:
-                    rc = subprocess.call(
-                        [py, os.path.join(REPO, "benchmarks",
-                                          "kernel_smoke.py"),
-                         "--require-tpu"],
-                        stdout=so, stderr=subprocess.STDOUT,
-                        timeout=1200, cwd=REPO,
-                    )
-                except subprocess.TimeoutExpired:
-                    rc = -1
+            rc = run_to_file(
+                [py, os.path.join(REPO, "benchmarks", "kernel_smoke.py"),
+                 "--require-tpu"],
+                smoke_out, 1200,
+            )
             log(f, f"kernel_smoke rc={rc} -> {smoke_out}")
             if rc != 0:
                 # a failed smoke is usually the tunnel dying mid-window,
@@ -129,7 +135,6 @@ def main():
                 log(f, "smoke FAILED — re-arming probe loop")
                 time.sleep(args.interval)
                 continue
-            smoke_fails = 0
             # ONE unpinned bench run BEFORE the battery (~3 min): a real
             # TPU unpinned run saves results/tpu/latest_bench.json (the
             # official driver-snapshot artifact) — the battery's arms
@@ -138,16 +143,29 @@ def main():
             # would otherwise leave no TPU number at all.  The tuned run
             # later overwrites this with the measured-defaults number.
             bench_out = os.path.join(OUT_DIR, "bench_first_window.out")
-            with open(bench_out, "w") as bo:
-                try:
-                    rcb = subprocess.call(
-                        [py, os.path.join(REPO, "bench.py")],
-                        stdout=bo, stderr=subprocess.STDOUT,
-                        timeout=900, cwd=REPO,
-                    )
-                except subprocess.TimeoutExpired:
-                    rcb = -1
+            rcb = run_to_file(
+                [py, os.path.join(REPO, "bench.py")], bench_out, 900
+            )
             log(f, f"first-window bench rc={rcb} -> {bench_out}")
+            if rcb != 0:
+                # the smoke passed seconds ago, so a failed/hung bench
+                # means the tunnel just died — launching a 3 h battery
+                # now would burn a bounded battery attempt against a
+                # wedged chip.  Treat it like a smoke failure
+                # (consecutive-counted) and re-arm.
+                smoke_fails += 1
+                if smoke_fails >= args.max_attempts:
+                    log(f, "first-window bench FAILED at max consecutive "
+                           "attempts — exiting; inspect "
+                           "bench_first_window.out")
+                    return 3
+                log(f, "first-window bench FAILED — re-arming probe loop")
+                time.sleep(args.interval)
+                continue
+            # both pre-battery gates passed: the consecutive-failure
+            # count resets HERE (resetting at the smoke pass would let
+            # alternating smoke-pass/bench-fail windows loop forever)
+            smoke_fails = 0
             battery_attempts += 1
             log(f, "running tpu_day1 battery")
             try:

@@ -262,6 +262,26 @@ def test_watcher_smoke_fails_do_not_exhaust_battery_budget(
     assert calls.count("bench.py") == 2
 
 
+def test_watcher_bench_failure_rearms_without_burning_battery_budget(
+    monkeypatch, tmp_path
+):
+    """A first-window bench failure means the tunnel died post-smoke:
+    re-arm the probe loop (consecutive-counted) instead of launching a
+    3 h battery against a wedged chip."""
+    rc, calls = _run_watcher(
+        monkeypatch, tmp_path,
+        probe_results=[(True, "ok")] * 3,
+        call_rcs=[
+            0, -1,        # smoke ok, bench timed out -> re-arm
+            0, 1,         # smoke ok, bench rc=1 -> re-arm
+            0, 0, 0, 0,   # smoke, bench, battery, analyze all pass
+        ],
+        argv=("tunnel_watch.py", "--max-attempts", "3"),
+    )
+    assert rc == 0
+    assert calls.count("tpu_day1.py") == 1  # battery budget untouched
+
+
 def test_watcher_removes_stale_stop_file_at_startup(monkeypatch, tmp_path):
     """A stop-file left over from a previous run must not make a fresh
     watcher exit rc=0 instantly (that would silently lose the round's
