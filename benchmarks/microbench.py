@@ -112,8 +112,11 @@ def bench_scatter(capacity=131_072, dims=(17, 64, 128), batch=16_384):
 def bench_topk(rows=131_072, dim=64, batch=64, k=100):
     """Exact MXU top-k, plus (on TPU, >=1M rows) the approx-top-k unit
     A/B: throughput AND measured recall vs the exact oracle — off-TPU
-    ``approx_max_k`` computes exactly, so recall there is vacuous
-    (VERDICT r3 next #8; the wiring test in tests/ says so honestly)."""
+    ``approx_max_k`` computes exactly, so recall there is vacuous.
+    SELF-CONTAINED: the public ``approx_recall`` parameter was removed in
+    round 5 (unproven after three windowless rounds — ops/topk.py
+    decision note), so the A/B calls ``jax.lax.approx_max_k`` directly;
+    a measured win here is the evidence for reinstating the parameter."""
     import jax
     import jax.numpy as jnp
 
@@ -139,7 +142,9 @@ def bench_topk(rows=131_072, dim=64, batch=64, k=100):
     _, ids_exact = exact(table_m, q_m)
     for target in (0.95, 0.99):
         apx = jax.jit(
-            lambda t, q, r=target: dense_topk(t, q, k, approx_recall=r)
+            lambda t, q, r=target: jax.lax.approx_max_k(
+                q @ t.T, k, recall_target=r
+            )
         )
         t_apx = _timeit(apx, table_m, q_m, iters=5)
         _, ids_apx = apx(table_m, q_m)

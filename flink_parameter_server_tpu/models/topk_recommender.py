@@ -51,15 +51,13 @@ def query_topk(
     k: int,
     *,
     exclude: Optional[Array] = None,
-    approx_recall: Optional[float] = None,
 ) -> Tuple[Array, Array]:
     """Top-k items for ``user_ids`` (B,) given worker-state user vectors.
 
     ``exclude``: optional (B, E) item ids to mask out (already-rated items
     — the reference's recommenders exclude seen pairs).
-    ``approx_recall``: route the candidate scan to the TPU approx-top-k
-    unit with that expected recall (exact by default).
-    Returns (scores (B,k), item_ids (B,k)).
+    Returns (scores (B,k), item_ids (B,k)).  (The former ``approx_recall``
+    parameter was removed — see the ops/topk.py decision note.)
     """
     spec = item_store.spec
     queries = jnp.take(user_vectors, user_ids.astype(jnp.int32), axis=0)
@@ -71,12 +69,9 @@ def query_topk(
             return sharded_topk(
                 table, queries, k,
                 mesh=spec.mesh, ps_axis=spec.ps_axis,
-                valid_rows=spec.capacity, approx_recall=approx_recall,
+                valid_rows=spec.capacity,
             )
-        return dense_topk(
-            table, queries, k,
-            valid_rows=spec.capacity, approx_recall=approx_recall,
-        )
+        return dense_topk(table, queries, k, valid_rows=spec.capacity)
 
     # With exclusions: over-fetch k+E candidates then drop excluded ones.
     e = exclude.shape[1]
@@ -84,12 +79,10 @@ def query_topk(
         scores, ids = sharded_topk(
             table, queries, k + e,
             mesh=spec.mesh, ps_axis=spec.ps_axis, valid_rows=spec.capacity,
-            approx_recall=approx_recall,
         )
     else:
         scores, ids = dense_topk(
-            table, queries, k + e,
-            valid_rows=spec.capacity, approx_recall=approx_recall,
+            table, queries, k + e, valid_rows=spec.capacity,
         )
     banned = (ids[:, :, None] == exclude[:, None, :]).any(-1)
     scores = jnp.where(banned, -jnp.inf, scores)
@@ -101,18 +94,14 @@ def query_topk(
     return re_scores, re_ids
 
 
-def make_mf_topk_step(
-    logic: OnlineMatrixFactorization, spec, k: int,
-    *, approx_recall: Optional[float] = None,
-):
+def make_mf_topk_step(logic: OnlineMatrixFactorization, spec, k: int):
     """Fused train+serve step: MF update plus a top-K answer for the
     batch's ``query_user`` ids — the batched analogue of the reference's
     interleaved query events in the rating stream.
 
     Queries are served against the *pre-push* table (bounded staleness of
     one microbatch — same semantics as training pulls).  Use in place of
-    ``make_train_step`` and jit the result.  ``approx_recall`` routes the
-    serving scan to the TPU approx-top-k unit (exact by default).
+    ``make_train_step`` and jit the result.
     """
     from ..core import store as store_mod
 
@@ -129,12 +118,11 @@ def make_mf_topk_step(
                 scores, top_ids = sharded_topk(
                     serve_table, q, k,
                     mesh=spec.mesh, ps_axis=spec.ps_axis,
-                    valid_rows=spec.capacity, approx_recall=approx_recall,
+                    valid_rows=spec.capacity,
                 )
             else:
                 scores, top_ids = dense_topk(
-                    serve_table, q, k,
-                    valid_rows=spec.capacity, approx_recall=approx_recall,
+                    serve_table, q, k, valid_rows=spec.capacity,
                 )
             out = dict(out, topk_scores=scores, topk_ids=top_ids)
         table = store_mod.push(spec, table, req.ids, req.deltas, req.mask)

@@ -18,6 +18,16 @@ same asymptotic saving LEMP's pruning buys the reference.
 
 All functions keep a static ``(B, k)`` output shape: when fewer than ``k``
 candidates exist, the tail is padded with ``-inf`` scores and id ``-1``.
+
+Round-5 decision note: an earlier ``approx_recall`` parameter routed the
+row scan to ``jax.lax.approx_max_k`` (the TPU approximate-top-k unit).
+Off-TPU that op computes exactly, so its recall/speedup claim at our
+shapes was untestable in this environment, and no hardware window opened
+across rounds 3–5 to measure it — per the round-4 verdict's decision
+rule the unproven parameter was REMOVED from the public surface.  The
+on-chip A/B (recall + speedup at 1M rows) lives self-contained in
+``benchmarks/microbench.py topk``; reinstating the parameter is a
+two-line change once hardware shows a win.
 """
 from __future__ import annotations
 
@@ -42,35 +52,14 @@ def _pad_topk(scores: Array, ids: Array, k: int) -> Tuple[Array, Array]:
     return scores, ids
 
 
-def _row_topk(scores: Array, k_eff: int, approx_recall: Optional[float]):
-    """Exact ``lax.top_k`` or TPU-hardware ``lax.approx_max_k``.
-
-    ``approx_max_k`` runs on the TPU's dedicated approximate-top-k
-    hardware path — asymptotically faster than exact sort-based top_k on
-    large row counts, with a guaranteed expected ``recall_target``
-    (non-TPU backends compute it exactly, so tests stay deterministic).
-    The LEMP comparison in the module docstring extends naturally:
-    exact = output parity with the reference, approx = the throughput
-    mode the reference's pruning strategies approximate from the other
-    direction."""
-    if approx_recall is not None:
-        return jax.lax.approx_max_k(
-            scores, k_eff, recall_target=approx_recall
-        )
-    return jax.lax.top_k(scores, k_eff)
-
-
 def dense_topk(
     table: Array,
     queries: Array,
     k: int,
     *,
     valid_rows: Optional[int] = None,
-    approx_recall: Optional[float] = None,
 ) -> Tuple[Array, Array]:
-    """Single-device top-k: one MXU matmul + top_k (exact by default;
-    ``approx_recall=0.95`` switches the scan to the TPU approx-top-k
-    unit with that expected recall).
+    """Single-device exact top-k: one MXU matmul + ``lax.top_k``.
 
     Returns (scores (B,k), ids (B,k)); padded with -inf/-1 when the table
     has fewer than ``k`` rows."""
@@ -79,7 +68,7 @@ def dense_topk(
         pad = jnp.arange(table.shape[0]) >= valid_rows
         scores = jnp.where(pad[None, :], -jnp.inf, scores)
     k_eff = min(k, table.shape[0])
-    top_scores, top_ids = _row_topk(scores, k_eff, approx_recall)
+    top_scores, top_ids = jax.lax.top_k(scores, k_eff)
     return _pad_topk(top_scores, top_ids, k)
 
 
@@ -91,12 +80,8 @@ def sharded_topk(
     mesh: Mesh,
     ps_axis: str = "ps",
     valid_rows: Optional[int] = None,
-    approx_recall: Optional[float] = None,
 ) -> Tuple[Array, Array]:
-    """Top-k over a ps-sharded table (see module docstring).  The
-    per-shard candidate scan is exact by default; ``approx_recall``
-    switches it to the TPU approx-top-k unit (the cross-shard merge over
-    ``shards·k`` candidates stays exact either way).
+    """Exact top-k over a ps-sharded table (see module docstring).
 
     ``table``: (padded_rows, dim) sharded P(ps, None).
     ``queries``: (B, dim), replicated.
@@ -116,7 +101,7 @@ def sharded_topk(
                 (global_row >= valid_rows)[None, :], -jnp.inf, scores
             )
         kk = min(k, rows)
-        local_scores, local_ids = _row_topk(scores, kk, approx_recall)
+        local_scores, local_ids = jax.lax.top_k(scores, kk)
         local_ids = local_ids + lo
         # all-gather candidates over ICI: (shards, B, kk) → (B, shards*kk)
         all_scores = jax.lax.all_gather(local_scores, ps_axis)
