@@ -630,6 +630,62 @@ def _save_tpu_artifact(payload):
     os.replace(tmp, _TPU_ARTIFACT)
 
 
+def _emit_serving_metric(platform: str, fallback: bool) -> None:
+    """Second metric line: the serve path (serving_qps + p99_ms).
+
+    Guarded like everything else in this bench: a serving-bench failure
+    must not take down the training metric the driver snapshots — it
+    degrades to a value-None line carrying the error.  The load is kept
+    small (short window, modest store) so the line costs seconds."""
+    metric = "serving top-K QPS (train-while-serve, online MF)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    raw = os.environ.get("FPS_BENCH_SERVING_SECONDS", "3")
+    try:
+        duration = float(raw)
+    except ValueError:
+        raise SystemExit(
+            f"FPS_BENCH_SERVING_SECONDS={raw!r}: expected a number"
+        ) from None
+    if duration <= 0:  # explicit opt-out of the serving line
+        return
+    try:
+        from benchmarks.serving_qps import run_serving_bench
+
+        r = run_serving_bench(
+            duration_s=duration,
+            concurrency=4,
+            num_items=8_192,
+            dim=32,
+            batch=4_096,
+        )
+        print(json.dumps({
+            "metric": metric,
+            "value": r["serving_qps"],
+            "unit": "queries/sec",
+            "extra": {
+                "serving_qps": r["serving_qps"],
+                "p50_ms": r["p50_ms"],
+                "p99_ms": r["p99_ms"],
+                "snapshot_staleness_mean_steps": r["staleness_mean_steps"],
+                "snapshot_staleness_max_steps": r["staleness_max_steps"],
+                "publish_every": r["publish_every"],
+                "batch_fill": r["batch_fill"],
+                "requests_rejected": r["requests_rejected"],
+                "concurrency": r["concurrency"],
+                "k": r["k"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "queries/sec",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -649,6 +705,9 @@ def main():
             payload["from_artifact"] = True
             payload.setdefault("extra", {})["artifact_captured_at"] = iso
             print(json.dumps(payload))
+            # the serve path runs fine on the CPU backend — measure it
+            # live even when the training number is an artifact replay
+            _emit_serving_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -698,6 +757,7 @@ def main():
         # experiments, not the headline — they never save it
         _save_tpu_artifact(payload)
     print(json.dumps(payload))
+    _emit_serving_metric(platform, fallback)
 
 
 if __name__ == "__main__":

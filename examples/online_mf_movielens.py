@@ -16,7 +16,8 @@ Without ``--path`` a synthetic Zipf-skewed MovieLens-like stream is used.
 "user,item,rating" TCP stream until the producer closes — the
 reference's canonical unbounded-source (socketTextStream) demo shape;
 id spaces then come from --num-users/--num-items (the stream is
-unbounded, so they cannot be inferred).  On a multi-device mesh,
+unbounded, so they cannot be inferred; combining --socket with the
+bounded-file options --path/--epochs is an error).  On a multi-device mesh,
 --num-users must be divisible by the dp size (worker state is
 dp-sharded).
 Runs on whatever devices are available (CPU mesh works:
@@ -44,6 +45,18 @@ def main():
     sock = params.get("socket")
     data = None
     if sock:
+        # the socket branch never reads --path/--epochs; silently
+        # ignoring them would train on different data/passes than the
+        # user asked for — refuse the contradictory combination
+        clash = [
+            f"--{key}" for key in ("path", "epochs") if key in params
+        ]
+        if clash:
+            raise SystemExit(
+                f"--socket streams unbounded live data and is "
+                f"incompatible with {', '.join(clash)} (bounded-file "
+                f"options); drop one side"
+            )
         num_users = params.get_int("num-users", 2000)
         num_items = params.get_int("num-items", 3000)
     else:

@@ -30,6 +30,13 @@ from .core.transform import (
     transform_with_model_load,
 )
 from .parallel.mesh import DP_AXIS, PS_AXIS, make_mesh
+from .serving import (
+    QueryEngine,
+    ServingClient,
+    ServingServer,
+    ServingService,
+    SnapshotManager,
+)
 from .training.driver import DriverConfig, StreamingDriver
 
 __version__ = "0.1.0"
@@ -62,4 +69,9 @@ __all__ = [
     "transform_dense",
     "DriverConfig",
     "StreamingDriver",
+    "QueryEngine",
+    "ServingClient",
+    "ServingServer",
+    "ServingService",
+    "SnapshotManager",
 ]

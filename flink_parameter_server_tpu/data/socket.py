@@ -106,6 +106,7 @@ class _RecordBatcher:
 
     def _run(self, records, batch_size, parse, pad_value, drop_remainder):
         rows: List[Dict[str, Any]] = []
+        expected_keys = None
         for rec in records:
             parsed = None
             try:
@@ -119,6 +120,25 @@ class _RecordBatcher:
                 # keeps failures observable.
                 pass
             if parsed is None:
+                self.dropped += 1
+                continue
+            # Per-row key validation (not just rows[0]): a parse() that
+            # returns inconsistent dict keys across records would
+            # otherwise raise an uncaught KeyError at stack time —
+            # killing the unbounded job this bridge exists to protect.
+            # Inconsistent rows are malformed records: count + continue.
+            if "mask" in parsed:
+                # reserved-name misuse is a PROGRAMMING error on every
+                # row it appears on, not stream corruption — stay loud
+                # (checked per row, so a row-3-only 'mask' no longer
+                # slips past the old rows[0]-only guard)
+                raise ValueError(
+                    "'mask' is reserved for the padding mask; have "
+                    "parse() return the column under another name"
+                )
+            if expected_keys is None:
+                expected_keys = frozenset(parsed)
+            elif frozenset(parsed) != expected_keys:
                 self.dropped += 1
                 continue
             rows.append(parsed)

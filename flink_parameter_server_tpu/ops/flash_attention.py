@@ -94,7 +94,7 @@ def flash_mha_dp(
     mixes batch rows).  Inside a jit whose activations are already
     dp-sharded this is a sharding-preserving no-op wrapper around the
     kernel — the multi-chip deployment of BASELINE config 5."""
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     B = q.shape[0]

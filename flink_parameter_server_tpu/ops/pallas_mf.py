@@ -535,7 +535,7 @@ def fused_mf_sgd_sharded(
     shard owns it), where the single-shard step predicts against the
     routed last row.  Valid lanes — masked included — are identical.
     """
-    from jax import shard_map
+    from ..utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     if interpret is None:

@@ -1,0 +1,56 @@
+"""serving/ — the online inference subsystem.
+
+Reference parity: the reference system's whole point is *online*
+learning — the model is useful while it trains — yet its only read
+path is the close()-time model dump.  This package is the missing
+query plane: versioned table snapshots decouple readers from the
+scatter-update step, an admission batcher coalesces concurrent
+requests into the fixed-shape microbatches the jitted query kernels
+need, and a line-protocol TCP server answers top-K recommendation
+queries against the live :class:`~..core.store.ShardedParamStore`
+while the :class:`~..training.driver.StreamingDriver` keeps training.
+
+Module map::
+
+  snapshot.py   TableSnapshot / SnapshotManager — donated-buffer
+                copy-on-publish with a publish_every cadence and
+                staleness metadata (steps behind the trainer)
+  batcher.py    RequestBatcher — bounded admission queue, pad-to-bucket
+                coalescing, deadline flush, reject-on-overload
+  engine.py     QueryEngine — jitted snapshot-read kernels: embedding
+                lookup, MF dot-product scoring, exact top-K with
+                exclusion masks (reuses ops/topk.sharded_topk)
+  server.py     ServingService (batcher + engine + dispatch thread),
+                ServingClient (in-process), ServingServer (TCP line
+                protocol, symmetric to data/socket.py's ingest edge)
+  metrics.py    ServingMetrics — QPS, batch-fill ratio, queue depth,
+                p50/p99 request latency, snapshot staleness
+
+Train-while-serve is one call::
+
+    driver = StreamingDriver(logic, store)
+    service = driver.serve_with(publish_every=4)
+    client = service.client()
+    ...                       # driver.run(batches) in one thread,
+    client.top_k(user, k=10)  # queries answered concurrently
+"""
+from .batcher import QueueFull, RequestBatcher
+from .engine import LookupResult, NoSnapshotError, QueryEngine, TopKResult
+from .metrics import ServingMetrics
+from .server import ServingClient, ServingServer, ServingService
+from .snapshot import SnapshotManager, TableSnapshot
+
+__all__ = [
+    "QueueFull",
+    "RequestBatcher",
+    "NoSnapshotError",
+    "QueryEngine",
+    "TopKResult",
+    "LookupResult",
+    "ServingMetrics",
+    "ServingService",
+    "ServingClient",
+    "ServingServer",
+    "SnapshotManager",
+    "TableSnapshot",
+]

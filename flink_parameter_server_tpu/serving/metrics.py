@@ -1,0 +1,116 @@
+"""Serving-side observability — the query-plane sibling of
+``training/metrics.StepMetrics``.
+
+Same machinery, same contract: a rolling-window tracker with a
+``snapshot()`` dict and a JSON-lines ``emit(sink)``, so the driver's
+``metrics_sink`` receives interleaved training and serving lines from
+one stream.  Tracked: QPS, request latency percentiles (admission →
+answer), batch-fill ratio (occupancy / padded bucket — how much of
+each compiled program is real work), queue depth, rejection count, and
+snapshot staleness (trainer steps the served table lags the live one).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class ServingMetrics:
+    """Rolling QPS/latency/fill tracker for the serve path.
+
+    Thread-safe: the dispatch thread records batches while any thread
+    snapshots.  ``queue_depth_fn`` / ``staleness_fn`` are live probes
+    wired in by the :class:`~.server.ServingService` so emission reads
+    the CURRENT queue/staleness, not a stale recorded value.
+    """
+
+    def __init__(self, window: int = 1024):
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._latencies: List[float] = []  # seconds, admission -> answer
+        self._fills: List[float] = []  # per batch: n / bucket
+        self._done_times: List[float] = []  # per request completion
+        self.total_requests = 0
+        self.total_batches = 0
+        self.total_rejected = 0
+        self.started_at = time.perf_counter()
+        self.queue_depth_fn: Optional[Callable[[], int]] = None
+        self.staleness_fn: Optional[Callable[[], Optional[int]]] = None
+
+    # -- recording ---------------------------------------------------------
+    def record_batch(
+        self, n: int, bucket: int, latencies_s: List[float]
+    ) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self.total_batches += 1
+            self.total_requests += n
+            self._fills.append(n / max(1, bucket))
+            self._latencies.extend(latencies_s)
+            self._done_times.extend([now] * n)
+            for buf in (self._latencies, self._fills, self._done_times):
+                if len(buf) > self.window:
+                    del buf[: len(buf) - self.window]
+
+    def record_reject(self, n: int = 1) -> None:
+        with self._lock:
+            self.total_rejected += n
+
+    # -- reporting ---------------------------------------------------------
+    def qps(self) -> float:
+        """Windowed queries/sec: completions in the window over the span
+        from the first windowed completion to now (robust to bursts)."""
+        with self._lock:
+            if not self._done_times:
+                return 0.0
+            span = time.perf_counter() - self._done_times[0]
+            n = len(self._done_times)
+        return n / span if span > 0 else 0.0
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            lat = list(self._latencies)
+        if not lat:
+            return {"p50": 0.0, "p90": 0.0, "p99": 0.0}
+        d = np.array(lat)
+        return {
+            "p50": float(np.percentile(d, 50)),
+            "p90": float(np.percentile(d, 90)),
+            "p99": float(np.percentile(d, 99)),
+        }
+
+    def batch_fill(self) -> float:
+        with self._lock:
+            return float(np.mean(self._fills)) if self._fills else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        lat = self.latency_percentiles()
+        out = {
+            "serving_requests": self.total_requests,
+            "serving_rejected": self.total_rejected,
+            "serving_qps": round(self.qps(), 1),
+            "serving_p50_ms": round(lat["p50"] * 1e3, 3),
+            "serving_p90_ms": round(lat["p90"] * 1e3, 3),
+            "serving_p99_ms": round(lat["p99"] * 1e3, 3),
+            "batch_fill": round(self.batch_fill(), 3),
+            "wall_s": round(time.perf_counter() - self.started_at, 3),
+        }
+        if self.queue_depth_fn is not None:
+            out["queue_depth"] = int(self.queue_depth_fn())
+        if self.staleness_fn is not None:
+            s = self.staleness_fn()
+            out["snapshot_staleness_steps"] = None if s is None else int(s)
+        return out
+
+    def emit(self, sink=None) -> str:
+        line = json.dumps(self.snapshot())
+        if sink is not None:
+            sink.write(line + "\n")
+        return line
+
+
+__all__ = ["ServingMetrics"]

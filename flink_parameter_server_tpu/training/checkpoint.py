@@ -257,7 +257,13 @@ class JobCheckpointManager:
         step = self.latest_step()
         if step is None:
             return None
-        payload = self._mgr.restore(step)
+        # explicit StandardRestore: a FRESH manager (the resume path —
+        # a new driver on an existing directory) has no handler
+        # registered for the saved "default" item and raises KeyError
+        # on an argless restore
+        payload = self._mgr.restore(
+            step, args=_ocp().args.StandardRestore()
+        )
         return _payload_to_state(payload, spec, worker_state_shardings)
 
     def wait(self) -> None:
@@ -291,7 +297,9 @@ def load_model(path: str, **from_values_kwargs) -> ShardedParamStore:
                     raise FileNotFoundError(
                         f"no checkpoint under {path!r}"
                     ) from None
-                payload = mgr.restore(step)
+                # fresh manager: see restore_latest — an argless
+                # restore has no handler for the saved item
+                payload = mgr.restore(step, args=ocp.args.StandardRestore())
     values = np.asarray(payload["table"])[: payload["meta"]["capacity"]]
     return ShardedParamStore.from_values(
         jax.numpy.asarray(values), **from_values_kwargs

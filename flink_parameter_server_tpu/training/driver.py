@@ -126,6 +126,7 @@ class StreamingDriver:
         self._state = None
         self._pending_skip = 0
         self._stop_requested = False
+        self._serving = None
         self._ckpt_mgr: Optional[ckpt.JobCheckpointManager] = None
         if self.config.checkpoint_dir is not None:
             self._ckpt_mgr = ckpt.JobCheckpointManager(
@@ -152,6 +153,36 @@ class StreamingDriver:
         batches, drains in-flight microbatches, checkpoints, and returns
         its partial result (same path as ``stop_signals``)."""
         self._stop_requested = True
+
+    # -- train-while-serve -------------------------------------------------
+    def serve_with(self, service=None, **service_kwargs):
+        """Attach an online-serving service (``serving/``): the driver
+        publishes table snapshots at the service's ``publish_every``
+        dispatch cadence — worker state riding along as the query-side
+        user vectors — so top-K queries are answered mid-training
+        without ever touching the live (donated) buffers.
+
+        Pass a prebuilt :class:`~..serving.ServingService`, or kwargs
+        for :meth:`ServingService.for_spec <..serving.ServingService.for_spec>`
+        (``publish_every=``, ``max_batch=``, ``max_queue=``, ...).
+        Returns the service — ``service.client()`` is the query handle;
+        serving starts at ``run()`` entry (the pre-training table is
+        published immediately) and keeps answering from the final
+        snapshot after ``run()`` returns.  With ``metrics_every`` set,
+        serving metrics lines are emitted to ``metrics_sink`` alongside
+        the training lines."""
+        if service is None:
+            from ..serving import ServingService
+
+            service = ServingService.for_spec(
+                self.store.spec, **service_kwargs
+            )
+        elif service_kwargs:
+            raise ValueError(
+                "pass either a prebuilt service or for_spec kwargs, not both"
+            )
+        self._serving = service
+        return service
 
     def resume(self) -> bool:
         """Restore (store, worker state, step cursor) from the latest
@@ -181,6 +212,13 @@ class StreamingDriver:
         skip = self._pending_skip if fast_forward else 0
         self._pending_skip = 0
         self._stop_requested = False  # a fresh run clears a prior stop
+        if self._serving is not None:
+            # serving is live from step 0: publish the pre-training
+            # table (queries that need worker state answer after the
+            # first mid-training publish carries it)
+            self._serving.on_train_start(
+                self.store, self.step_idx, state=self._state
+            )
 
         import collections
 
@@ -241,6 +279,11 @@ class StreamingDriver:
                 self.metrics.step_end(events, n_steps=n_steps)
                 self.metrics.step_start()
             self.step_idx = global_step
+            if self._serving is not None:
+                # snapshot publish (copy-on-publish, cadence-gated) runs
+                # on THIS thread, so the copy is sequenced before the
+                # next dispatch donates the table buffer
+                self._serving.on_dispatch(table, state, global_step)
 
             def crossed(every):
                 # did (prev_global, global_step] cross a multiple of
@@ -278,6 +321,8 @@ class StreamingDriver:
                     )
             if crossed(cfg.metrics_every):
                 self.metrics.emit(self.metrics_sink)
+                if self._serving is not None:
+                    self._serving.metrics.emit(self.metrics_sink)
             if is_ckpt_step:
                 # Save straight from the live buffers WITHOUT stashing them
                 # on self: the next jitted step donates (deletes) them, and
@@ -353,6 +398,12 @@ class StreamingDriver:
 
         self.store = result.store
         self._state = result.worker_state
+        if self._serving is not None:
+            # close-time publish: post-run queries answer from the FINAL
+            # table (the serve-path analogue of the §3.5 model flush)
+            self._serving.on_dispatch(
+                self.store.table, self._state, self.step_idx, force=True
+            )
         self.save()
         return result
 

@@ -1,0 +1,31 @@
+"""Version-portability shims for the jax API surface this repo spans.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax`` namespace, and its replication-check kwarg was renamed
+``check_rep`` → ``check_vma`` along the way.  Every module in this
+package imports :func:`shard_map` from here so the whole repo tracks one
+resolution of that move instead of eight.
+"""
+from __future__ import annotations
+
+try:  # modern jax: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental namespace, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalised to
+    the modern ``check_vma`` spelling regardless of the installed jax."""
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+__all__ = ["shard_map"]

@@ -116,6 +116,37 @@ def test_mf_example_from_socket():
     assert "socket stream ended" in r.stdout
 
 
+def test_serve_recommendations_example():
+    """Train-while-serve demo: in-process top-K queries mid-training,
+    then a TCP round trip against the final model."""
+    r = _run(
+        [
+            os.path.join("examples", "serve_recommendations.py"),
+            "--num-users", "64", "--num-items", "96", "--dim", "8",
+            "--ratings", "20000", "--batch", "1024", "--epochs", "1",
+            "--queries", "4", "--k", "5",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "top-5" in r.stdout
+    assert "steps stale" in r.stdout
+    assert "tcp answer" in r.stdout
+    assert "serving_qps" in r.stdout
+
+
+def test_mf_example_socket_path_conflict_is_loud():
+    """--socket with --path/--epochs must refuse, not silently ignore
+    the bounded-file options (ADVICE.md round-5)."""
+    r = _run(
+        [
+            os.path.join("examples", "online_mf_movielens.py"),
+            "--socket", "127.0.0.1:1", "--epochs", "2",
+        ]
+    )
+    assert r.returncode != 0
+    assert "incompatible" in (r.stderr + r.stdout)
+
+
 def test_production_driver_example():
     r = _run(
         [

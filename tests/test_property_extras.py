@@ -4,6 +4,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytest.importorskip("hypothesis")  # optional dep: skip, not a collection error
 from hypothesis import given, settings, strategies as st
 
 from flink_parameter_server_tpu.ops.hashing import (

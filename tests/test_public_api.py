@@ -17,6 +17,8 @@ TOP_LEVEL = [
     "TransformResult", "make_mesh", "DP_AXIS", "PS_AXIS",
     "StreamingDriver", "DriverConfig",
     "Pull", "Push", "PullAnswer", "WorkerToPS", "PSToWorker",
+    "ServingService", "ServingClient", "ServingServer", "QueryEngine",
+    "SnapshotManager",
 ]
 
 MODULE_SYMBOLS = {
@@ -72,6 +74,16 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.utils.initializers": [
         "ranged_random_factor", "normal_factor", "zeros"],
     "flink_parameter_server_tpu.utils.config": ["Parameters"],
+    "flink_parameter_server_tpu.serving.snapshot": [
+        "TableSnapshot", "SnapshotManager"],
+    "flink_parameter_server_tpu.serving.batcher": [
+        "RequestBatcher", "QueueFull"],
+    "flink_parameter_server_tpu.serving.engine": [
+        "QueryEngine", "TopKResult", "LookupResult", "NoSnapshotError"],
+    "flink_parameter_server_tpu.serving.server": [
+        "ServingService", "ServingClient", "ServingServer",
+        "tcp_request", "parse_response", "format_response"],
+    "flink_parameter_server_tpu.serving.metrics": ["ServingMetrics"],
 }
 
 

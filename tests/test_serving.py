@@ -1,0 +1,461 @@
+"""serving/ — snapshot isolation, batcher admission discipline, top-K
+parity vs a numpy oracle, train-while-serve through
+``StreamingDriver.serve_with``, and the TCP line protocol round trip.
+"""
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from flink_parameter_server_tpu.core.store import ShardedParamStore
+from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+from flink_parameter_server_tpu.data.streams import microbatches
+from flink_parameter_server_tpu.models.matrix_factorization import (
+    OnlineMatrixFactorization,
+    SGDUpdater,
+)
+from flink_parameter_server_tpu.serving import (
+    QueryEngine,
+    QueueFull,
+    RequestBatcher,
+    ServingServer,
+    ServingService,
+    SnapshotManager,
+)
+from flink_parameter_server_tpu.serving.server import tcp_request
+from flink_parameter_server_tpu.training.driver import (
+    DriverConfig,
+    StreamingDriver,
+)
+from flink_parameter_server_tpu.utils.initializers import (
+    normal_factor,
+    ranged_random_factor,
+)
+
+
+# ---------------------------------------------------------------------------
+# snapshot.py
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_isolation_and_publish_cadence():
+    """Reads from a published snapshot are bit-identical across
+    concurrent pushes; republish happens only at the cadence."""
+    store = ShardedParamStore.create(
+        32, (4,), init_fn=normal_factor(0, (4,))
+    )
+    mgr = SnapshotManager(store.spec, publish_every=3)
+    snap1 = mgr.publish(store.table, step=0)
+    frozen = np.asarray(snap1.table).copy()
+
+    pushed = store.push(
+        jnp.array([1, 2, 3]), jnp.ones((3, 4), jnp.float32)
+    )
+    assert not np.allclose(np.asarray(pushed.table), frozen)  # live moved
+    # the published snapshot did NOT move
+    np.testing.assert_array_equal(np.asarray(mgr.latest().table), frozen)
+
+    # below the cadence: no republish, but staleness ticks
+    assert mgr.maybe_publish(pushed.table, step=2) is None
+    assert mgr.latest().version == 1
+    assert mgr.staleness() == 2
+
+    # at the cadence: new version, new table
+    snap2 = mgr.maybe_publish(pushed.table, step=3)
+    assert snap2 is not None and snap2.version == 2
+    np.testing.assert_array_equal(
+        np.asarray(mgr.latest().table), np.asarray(pushed.table)
+    )
+    assert mgr.staleness() == 0
+
+
+def test_snapshot_copy_survives_source_donation():
+    """The published copy must be independent of the source buffer (the
+    training loop donates it into the next jitted step)."""
+    import jax
+
+    store = ShardedParamStore.create(16, (2,), init_fn=normal_factor(0, (2,)))
+    mgr = SnapshotManager(store.spec)
+    mgr.publish(store.table, step=0)
+    frozen = np.asarray(mgr.latest().table).copy()
+
+    donating = jax.jit(lambda t: t * 2.0, donate_argnums=(0,))
+    _ = donating(store.table)  # source buffer is now deleted
+    np.testing.assert_array_equal(np.asarray(mgr.latest().table), frozen)
+
+
+# ---------------------------------------------------------------------------
+# batcher.py
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_flushes_immediately_when_full():
+    b = RequestBatcher(max_batch=4, max_delay_ms=10_000, max_queue=64)
+    for i in range(4):
+        b.submit(i)
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=1)
+    assert time.monotonic() - t0 < 1.0  # no deadline wait on a full batch
+    assert [p.payload for p in batch] == [0, 1, 2, 3]
+
+
+def test_batcher_deadline_flush_for_partial_batch():
+    b = RequestBatcher(max_batch=64, max_delay_ms=50, max_queue=64)
+    b.submit("a")
+    b.submit("b")
+    t0 = time.monotonic()
+    batch = b.next_batch(timeout=5)
+    dt = time.monotonic() - t0
+    assert [p.payload for p in batch] == ["a", "b"]
+    assert dt < 2.0  # flushed by deadline, not by a full batch
+
+
+def test_batcher_rejects_not_blocks_on_overload():
+    b = RequestBatcher(max_batch=4, max_delay_ms=1_000, max_queue=3)
+    for i in range(3):
+        b.submit(i)
+    t0 = time.monotonic()
+    with pytest.raises(QueueFull):
+        b.submit(99)
+    assert time.monotonic() - t0 < 0.5  # reject is immediate, never a block
+    assert b.rejected == 1 and b.submitted == 3 and b.depth == 3
+
+
+def test_batcher_buckets_and_close():
+    b = RequestBatcher(max_batch=16, max_delay_ms=1)
+    assert b.buckets == (1, 2, 4, 8, 16)
+    assert b.bucket_for(1) == 1
+    assert b.bucket_for(3) == 4
+    assert b.bucket_for(16) == 16
+    fut = b.submit("x")
+    b.close()
+    with pytest.raises(RuntimeError):
+        fut.result(timeout=1)
+    with pytest.raises(RuntimeError):
+        b.submit("y")
+    assert b.next_batch(timeout=0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# engine.py — top-K parity vs a numpy oracle (with exclusions)
+# ---------------------------------------------------------------------------
+
+
+def _np_topk_oracle(table, queries, k, exclude=None):
+    """(B, k) exact MIPS top-k ids by brute force."""
+    scores = queries @ table.T
+    if exclude is not None:
+        for b in range(scores.shape[0]):
+            for e in exclude[b]:
+                if e >= 0:
+                    scores[b, e] = -np.inf
+    order = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    return order, np.take_along_axis(scores, order, axis=1)
+
+
+def _published_engine(num_items, dim, num_users, seed=0, mesh=None):
+    rng = np.random.default_rng(seed)
+    table = rng.normal(0, 1, (num_items, dim)).astype(np.float32)
+    uv = rng.normal(0, 1, (num_users, dim)).astype(np.float32)
+    store = ShardedParamStore.from_values(jnp.asarray(table), mesh=mesh)
+    mgr = SnapshotManager(store.spec)
+    mgr.publish(store.table, step=0, aux=jnp.asarray(uv))
+    return QueryEngine(mgr), table, uv
+
+
+def test_topk_matches_numpy_oracle():
+    engine, table, uv = _published_engine(257, 16, 40)  # odd row count
+    users = np.array([0, 7, 39, 7], np.int32)
+    res = engine.top_k(users, k=9)
+    exp_ids, exp_scores = _np_topk_oracle(table, uv[users], 9)
+    np.testing.assert_array_equal(res.item_ids, exp_ids)
+    np.testing.assert_allclose(res.scores, exp_scores, rtol=1e-5)
+    assert res.version == 1 and res.staleness == 0
+
+
+def test_topk_exclusion_mask_parity():
+    engine, table, uv = _published_engine(128, 8, 10, seed=3)
+    users = np.array([1, 2, 3], np.int32)
+    # exclude each user's unexcluded top-3 (the strongest candidates),
+    # padding one row with -1 lanes
+    base_ids, _ = _np_topk_oracle(table, uv[users], 3)
+    exclude = base_ids.astype(np.int32).copy()
+    exclude[2, 1:] = -1  # partially padded exclusion row
+    res = engine.top_k(users, k=5, exclude=exclude)
+    exp_ids, exp_scores = _np_topk_oracle(table, uv[users], 5, exclude)
+    np.testing.assert_array_equal(res.item_ids, exp_ids)
+    np.testing.assert_allclose(res.scores, exp_scores, rtol=1e-5)
+    # excluded ids never appear
+    for b in range(3):
+        banned = {int(e) for e in exclude[b] if e >= 0}
+        assert banned.isdisjoint(set(int(i) for i in res.item_ids[b]))
+
+
+def test_topk_sharded_store_parity(mesh):
+    """Same oracle through the ps-sharded path (sharded_topk)."""
+    engine, table, uv = _published_engine(256, 8, 12, seed=5, mesh=mesh)
+    users = np.arange(8, dtype=np.int32)
+    res = engine.top_k(users, k=7)
+    exp_ids, exp_scores = _np_topk_oracle(table, uv[users], 7)
+    np.testing.assert_array_equal(res.item_ids, exp_ids)
+    np.testing.assert_allclose(res.scores, exp_scores, rtol=1e-5)
+
+
+def test_lookup_and_score_read_the_snapshot():
+    engine, table, uv = _published_engine(64, 4, 6, seed=7)
+    got = engine.lookup(np.array([0, 5, 63], np.int32))
+    np.testing.assert_allclose(got.values, table[[0, 5, 63]], rtol=1e-6)
+    sc = engine.score(np.array([1, 2]), np.array([10, 20]))
+    exp = np.sum(uv[[1, 2]] * table[[10, 20]], axis=-1)
+    np.testing.assert_allclose(sc.values, exp, rtol=1e-5)
+
+
+def test_engine_before_first_publish_is_loud():
+    from flink_parameter_server_tpu.serving import NoSnapshotError
+
+    store = ShardedParamStore.create(8, (2,))
+    engine = QueryEngine(SnapshotManager(store.spec))
+    with pytest.raises(NoSnapshotError):
+        engine.lookup([0])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: train-while-serve through StreamingDriver.serve_with
+# ---------------------------------------------------------------------------
+
+
+def _mf_driver(num_users, num_items, dim, seed=0, **cfg):
+    logic = OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(0.05)
+    )
+    store = ShardedParamStore.create(
+        num_items, (dim,),
+        init_fn=ranged_random_factor(seed + 1, (dim,)),
+    )
+    return StreamingDriver(
+        logic, store, config=DriverConfig(dump_model=False, **cfg)
+    )
+
+
+def test_serve_with_answers_topk_mid_training():
+    num_users, num_items, dim = 120, 200, 8
+    driver = _mf_driver(num_users, num_items, dim)
+    service = driver.serve_with(
+        publish_every=2, max_batch=16, max_delay_ms=1.0
+    )
+    client = service.client()
+    data = synthetic_ratings(num_users, num_items, 60_000, rank=4, seed=0)
+    batches = list(microbatches(data, 512, epochs=2, shuffle_seed=0))
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(
+            driver.run(batches, collect_outputs=False)
+        )
+    )
+    t.start()
+    try:
+        # version 2 = first mid-training publish (carries worker state)
+        assert service.wait_for_snapshot(60, min_version=2)
+        mid = client.top_k(3, k=5, exclude=[0, 1])
+        assert mid.version >= 2
+        assert mid.staleness >= 0
+        assert len(set(int(i) for i in mid.item_ids)) == 5
+        assert all(0 <= i < num_items for i in mid.item_ids)
+        assert 0 not in mid.item_ids and 1 not in mid.item_ids
+    finally:
+        t.join(timeout=300)
+    assert results, "driver.run raised in the training thread"
+
+    # post-run queries answer from the FINAL table: parity with a direct
+    # query_topk on the trained store + worker state
+    from flink_parameter_server_tpu.models.topk_recommender import query_topk
+
+    final = client.top_k(7, k=6)
+    exp_scores, exp_ids = query_topk(
+        driver.store, results[0].worker_state, jnp.array([7]), 6
+    )
+    np.testing.assert_array_equal(final.item_ids, np.asarray(exp_ids)[0])
+    np.testing.assert_allclose(
+        final.scores, np.asarray(exp_scores)[0], rtol=1e-5
+    )
+    assert final.staleness == 0
+    service.stop()
+
+
+def test_serve_with_snapshot_frozen_between_publishes():
+    """With an effectively-infinite publish cadence, every mid-training
+    read is bit-identical to the initial table even though the trainer
+    keeps pushing (the acceptance-criteria isolation property)."""
+    num_users, num_items, dim = 60, 80, 4
+    driver = _mf_driver(num_users, num_items, dim, seed=2)
+    initial = np.asarray(driver.store.values()).copy()
+    service = driver.serve_with(
+        publish_every=10**9, max_batch=8, max_delay_ms=1.0
+    )
+    client = service.client()
+    data = synthetic_ratings(num_users, num_items, 30_000, rank=4, seed=2)
+    batches = list(microbatches(data, 256, epochs=1, shuffle_seed=0))
+
+    def throttled():
+        # pace the stream so the reader below provably overlaps
+        # training (a free-running CPU run could finish before the
+        # first query kernel compiles)
+        for b in batches:
+            time.sleep(0.005)
+            yield b
+
+    probe = np.array([0, 13, 79], np.int32)
+    reads = []
+    done = threading.Event()
+
+    def trainer():
+        try:
+            driver.run(throttled(), collect_outputs=False)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=trainer)
+    t.start()
+    try:
+        while not done.is_set():
+            reads.append(client.lookup(probe))
+    finally:
+        t.join(timeout=300)
+    assert reads, "no reads completed while training"
+    mid_reads = [r for r in reads if r.version == 1]
+    assert mid_reads, "every read raced past the final publish"
+    for r in mid_reads:
+        np.testing.assert_array_equal(r.values, initial[probe])
+    # training DID move the table (the reads were frozen, not the model)
+    assert not np.allclose(np.asarray(driver.store.values()), initial)
+    # ... and the close-time force publish exposed the final table
+    final = client.lookup(probe)
+    np.testing.assert_allclose(
+        final.values, np.asarray(driver.store.values())[probe], rtol=1e-6
+    )
+    service.stop()
+
+
+def test_service_rejects_when_overloaded_without_dispatch():
+    """Bounded admission: with no dispatch thread draining, the queue
+    fills and the next submit REJECTS immediately (never blocks)."""
+    store = ShardedParamStore.create(16, (2,))
+    service = ServingService.for_spec(
+        store.spec, max_queue=4, max_batch=4, max_delay_ms=1.0
+    )
+    for i in range(4):
+        service.submit_topk(i, k=1)
+    t0 = time.monotonic()
+    with pytest.raises(QueueFull):
+        service.submit_topk(99, k=1)
+    assert time.monotonic() - t0 < 0.5
+    assert service.metrics.total_rejected == 1
+    service.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# server.py — TCP line-protocol round trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def tcp_server():
+    engine, table, uv = _published_engine(96, 8, 20, seed=11)
+    service = ServingService(
+        engine,
+        RequestBatcher(max_batch=16, max_delay_ms=1.0, max_queue=64),
+    )
+    server = ServingServer(service).start()
+    yield server, table, uv
+    server.stop()
+    service.stop()
+
+
+def test_tcp_topk_round_trip(tcp_server):
+    server, table, uv = tcp_server
+    resp = tcp_request(server.host, server.port, "topk 4 5")
+    assert resp["ok"]
+    exp_ids, exp_scores = _np_topk_oracle(table, uv[[4]], 5)
+    assert resp["item_ids"] == exp_ids[0].tolist()
+    np.testing.assert_allclose(resp["scores"], exp_scores[0], rtol=1e-4)
+    assert resp["version"] == 1 and resp["staleness"] == 0
+
+
+def test_tcp_topk_with_exclusions(tcp_server):
+    server, table, uv = tcp_server
+    base = tcp_request(server.host, server.port, "topk 2 3")
+    banned = ",".join(str(i) for i in base["item_ids"])
+    resp = tcp_request(server.host, server.port, f"topk 2 3 {banned}")
+    assert resp["ok"]
+    assert set(resp["item_ids"]).isdisjoint(set(base["item_ids"]))
+
+
+def test_tcp_pull_round_trip(tcp_server):
+    server, table, uv = tcp_server
+    resp = tcp_request(server.host, server.port, "pull 0,17,95")
+    assert resp["ok"]
+    got = np.array(resp["values"], np.float32)
+    np.testing.assert_allclose(got, table[[0, 17, 95]], rtol=1e-4)
+
+
+def test_tcp_pipelined_requests_one_connection(tcp_server):
+    """N requests down one connection come back as N ordered responses
+    (the line protocol's per-connection FIFO contract)."""
+    import socket as pysocket
+
+    server, table, uv = tcp_server
+    with pysocket.create_connection(
+        (server.host, server.port), timeout=30
+    ) as s:
+        s.sendall(b"topk 1 3\ntopk 2 3\npull 5\n")
+        buf = b""
+        while buf.count(b"\n") < 3:
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                break
+            buf += chunk
+    lines = buf.decode().strip().split("\n")
+    assert len(lines) == 3
+    from flink_parameter_server_tpu.serving.server import parse_response
+
+    r1, r2, r3 = (parse_response(ln) for ln in lines)
+    assert r1["ok"] and r2["ok"] and r3["ok"]
+    assert "item_ids" in r1 and "item_ids" in r2 and "values" in r3
+    np.testing.assert_allclose(
+        np.array(r3["values"][0], np.float32), table[5], rtol=1e-4
+    )
+
+
+def test_tcp_malformed_requests_answer_err(tcp_server):
+    server, _, _ = tcp_server
+    assert not tcp_request(server.host, server.port, "bogus 1 2")["ok"]
+    assert not tcp_request(server.host, server.port, "topk 1")["ok"]
+    assert not tcp_request(server.host, server.port, "topk 1 0")["ok"]
+    assert not tcp_request(server.host, server.port, "pull")["ok"]
+
+
+# ---------------------------------------------------------------------------
+# metrics.py
+# ---------------------------------------------------------------------------
+
+
+def test_serving_metrics_snapshot_shape():
+    from flink_parameter_server_tpu.serving import ServingMetrics
+
+    m = ServingMetrics()
+    m.record_batch(3, 4, [0.001, 0.002, 0.004])
+    m.record_reject()
+    m.queue_depth_fn = lambda: 2
+    m.staleness_fn = lambda: 5
+    snap = m.snapshot()
+    assert snap["serving_requests"] == 3
+    assert snap["serving_rejected"] == 1
+    assert snap["batch_fill"] == 0.75
+    assert snap["queue_depth"] == 2
+    assert snap["snapshot_staleness_steps"] == 5
+    assert snap["serving_p99_ms"] >= snap["serving_p50_ms"] > 0
+    line = m.emit()
+    assert "serving_qps" in line

@@ -110,11 +110,50 @@ def test_parse_reserved_mask_key_is_loud():
         list(it)
 
 
+def test_parse_reserved_mask_key_is_loud_on_any_row():
+    """The reserved-name guard must fire per row, not just on rows[0]:
+    a 'mask' appearing only mid-stream used to slip past the old
+    rows[0]-only check (ADVICE.md round-5)."""
+
+    def parse(ln):
+        if ln == "bad":
+            return {"v": np.int32(0), "mask": np.bool_(True)}
+        return {"v": np.int32(ln)}
+
+    it = batches_from_records(iter(["1", "2", "bad"]), 8, parse)
+    with pytest.raises(ValueError, match="reserved"):
+        list(it)
+
+
+def test_inconsistent_row_keys_drop_not_crash():
+    """A parse() that returns different dict keys across records must
+    not kill the unbounded job with a KeyError at stack time: rows
+    whose key set differs from the first valid row's are counted as
+    dropped (ADVICE.md round-5)."""
+
+    def parse(ln):
+        if ln == "extra":
+            return {"v": np.int32(7), "bonus": np.int32(1)}
+        if ln == "missing":
+            return {"w": np.int32(8)}
+        return {"v": np.int32(ln)}
+
+    records = ["1", "extra", "2", "missing", "3"]
+    it = batches_from_records(iter(records), 2, parse)
+    batches = list(it)
+    assert it.dropped == 2  # 'extra' and 'missing', counted not fatal
+    got = [
+        int(v) for b in batches for v, m in zip(b["v"], b["mask"]) if m
+    ]
+    assert got == [1, 2, 3]  # the consistent rows all survived
+
+
 def test_batcher_invariants_property():
     """Hypothesis: for ANY mix of valid/malformed records and any batch
     size — total masked-in lanes == valid records, .dropped == malformed
     records, every batch is exactly batch_size wide (static shapes),
     and record order/values survive."""
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings, strategies as st
 
     @settings(max_examples=60, deadline=None)

@@ -221,6 +221,7 @@ def test_sorted_scatter_ids_sorted_property():
     beyond-oob lanes anywhere) and any mask, the ids_sorted fast path
     equals the sequential oracle — the promise chain is numerically
     inert."""
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings, strategies as st
 
     CAP, DIM = 16, 3
