@@ -686,6 +686,57 @@ def _emit_serving_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_recovery_metric(platform: str, fallback: bool) -> None:
+    """Third metric line: the recovery path (recovery_seconds +
+    updates_lost).  Same guard discipline as the serving line: a
+    recovery-bench failure degrades to a value-None line carrying the
+    error, never takes down the training metric.  FPS_BENCH_RECOVERY=0
+    opts out; the load is small (tens of small-batch steps, CPU-fine)
+    so the line costs seconds."""
+    metric = "crash recovery (checkpoint + WAL replay, online MF)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    raw = os.environ.get("FPS_BENCH_RECOVERY", "1")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_RECOVERY={raw!r}: 0|1")
+    if raw == "0":  # explicit opt-out of the recovery line
+        return
+    try:
+        from benchmarks.recovery_time import run_recovery_bench
+
+        r = run_recovery_bench(
+            steps=20,
+            crash_at=13,
+            checkpoint_every=6,
+            batch=1_024,
+            num_items=2_048,
+            dim=16,
+        )
+        print(json.dumps({
+            "metric": metric,
+            "value": r["recovery_seconds"],
+            "unit": "seconds",
+            "extra": {
+                "recovery_seconds": r["recovery_seconds"],
+                "updates_lost": r["updates_lost"],
+                "tables_bitwise_equal": r["tables_bitwise_equal"],
+                "replayed_steps": r["replayed_steps"],
+                "restarts": r["restarts"],
+                "checkpoint_every": r["checkpoint_every"],
+                "crash_at_step": r["crash_at_step"],
+                "wal_bytes_peak": r["wal_bytes_peak"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "seconds",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -705,9 +756,11 @@ def main():
             payload["from_artifact"] = True
             payload.setdefault("extra", {})["artifact_captured_at"] = iso
             print(json.dumps(payload))
-            # the serve path runs fine on the CPU backend — measure it
-            # live even when the training number is an artifact replay
+            # the serve and recovery paths run fine on the CPU backend —
+            # measure them live even when the training number is an
+            # artifact replay
             _emit_serving_metric(platform, fallback)
+            _emit_recovery_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -758,6 +811,7 @@ def main():
         _save_tpu_artifact(payload)
     print(json.dumps(payload))
     _emit_serving_metric(platform, fallback)
+    _emit_recovery_metric(platform, fallback)
 
 
 if __name__ == "__main__":

@@ -9,7 +9,13 @@ Usage (ParameterTool-style args — utils/config.py):
         [--socket host:port] [--num-users N] [--num-items M]
         [--dim 32] [--lr 0.05] [--epochs 3] [--batch 4096]
         [--scatter xla|pallas|xla_sorted] [--layout dense|packed|auto]
-        [--presort 0|1] [--steps-per-call 1]
+        [--presort 0|1] [--steps-per-call 1] [--chaos SEED]
+
+``--chaos SEED`` demonstrates the resilience layer end to end: a
+seeded FaultPlan crashes the job mid-training, and a RecoveringDriver
+(checkpoints + update WAL under a temp workdir) restores, replays the
+WAL tail and finishes the run — the printed factors match a
+crash-free run bitwise.  See docs/resilience.md.
 
 Without ``--path`` a synthetic Zipf-skewed MovieLens-like stream is used.
 ``--socket host:port`` instead trains from a LIVE newline-delimited
@@ -35,6 +41,77 @@ from flink_parameter_server_tpu.data.movielens import (
 from flink_parameter_server_tpu.data.streams import microbatches
 from flink_parameter_server_tpu.models.matrix_factorization import ps_online_mf
 from flink_parameter_server_tpu.utils.config import Parameters
+
+
+def _run_with_chaos(params, make_stream, *, num_users, num_items, mesh):
+    """The --chaos path: same MF job, but supervised — a seeded fault
+    plan crashes it mid-training and the RecoveringDriver brings it
+    back via checkpoint + WAL replay (resilience/)."""
+    import tempfile
+
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.resilience import (
+        FaultPlan,
+        RecoveringDriver,
+        RestartPolicy,
+    )
+    from flink_parameter_server_tpu.training.driver import (
+        DriverConfig,
+        StreamingDriver,
+    )
+    from flink_parameter_server_tpu.utils.initializers import (
+        ranged_random_factor,
+    )
+
+    seed = params.get_int("chaos", 0)
+    logic = OnlineMatrixFactorization(
+        num_users,
+        params.get_int("dim", 32),
+        updater=SGDUpdater(params.get_float("lr", 0.05)),
+        mesh=mesh,
+    )
+    store = ShardedParamStore.create(
+        num_items,
+        (params.get_int("dim", 32),),
+        init_fn=ranged_random_factor(1, (params.get_int("dim", 32),)),
+        mesh=mesh,
+        scatter_impl=params.get("scatter", "xla"),
+        layout=params.get("layout", "dense"),
+    )
+    workdir = tempfile.mkdtemp(prefix="fps_chaos_demo_")
+    driver = StreamingDriver(
+        logic, store,
+        config=DriverConfig(
+            dump_model=False,
+            checkpoint_every=params.get_int("checkpoint-every", 10),
+            checkpoint_dir=f"{workdir}/ckpt",
+            wal_dir=f"{workdir}/wal",
+            presort=params.get_bool("presort", False),
+            steps_per_call=params.get_int("steps-per-call", 1),
+        ),
+    )
+    plan = FaultPlan.from_seed(
+        seed, horizon=params.get_int("chaos-horizon", 40)
+    )
+    driver.add_group_hook(plan.driver_hook())
+    rec = RecoveringDriver(
+        driver,
+        lambda: plan.wrap_source(make_stream()),
+        policy=RestartPolicy(seed=seed),
+        metrics_sink=sys.stderr,
+    )
+    print(f"chaos seed {seed}: plan {plan.faults} (workdir {workdir})")
+    res = rec.run(collect_outputs=False)
+    print(
+        f"chaos run survived: {rec.restarts} restart(s), "
+        f"{rec.steps_replayed} WAL step(s) replayed, "
+        f"{rec.steps_dropped} step(s) dropped"
+    )
+    return res
 
 
 def main():
@@ -95,32 +172,46 @@ def main():
                 "rating": np.float32(r),
             }
 
-        stream = batches_from_records(
-            socket_text_stream(host, int(port)),
-            params.get_int("batch", 4096),
-            parse,
+        def make_stream():
+            # a fresh dial per (re)start — socket_text_stream itself
+            # reconnects through transient drops (data/socket.py)
+            return batches_from_records(
+                socket_text_stream(host, int(port)),
+                params.get_int("batch", 4096),
+                parse,
+            )
+
+        stream = make_stream()
+    else:
+        def make_stream():
+            return microbatches(
+                data,
+                params.get_int("batch", 4096),
+                epochs=params.get_int("epochs", 3),
+                shuffle_seed=0,
+            )
+
+        stream = make_stream()
+
+    if "chaos" in params:
+        res = _run_with_chaos(
+            params, make_stream, num_users=num_users, num_items=num_items,
+            mesh=mesh,
         )
     else:
-        stream = microbatches(
-            data,
-            params.get_int("batch", 4096),
-            epochs=params.get_int("epochs", 3),
-            shuffle_seed=0,
+        res = ps_online_mf(
+            stream,
+            num_users=num_users,
+            num_items=num_items,
+            dim=params.get_int("dim", 32),
+            learning_rate=params.get_float("lr", 0.05),
+            mesh=mesh,
+            collect_outputs=False,
+            scatter_impl=params.get("scatter", "xla"),
+            layout=params.get("layout", "dense"),
+            presort=params.get_bool("presort", False),
+            steps_per_call=params.get_int("steps-per-call", 1),
         )
-
-    res = ps_online_mf(
-        stream,
-        num_users=num_users,
-        num_items=num_items,
-        dim=params.get_int("dim", 32),
-        learning_rate=params.get_float("lr", 0.05),
-        mesh=mesh,
-        collect_outputs=False,
-        scatter_impl=params.get("scatter", "xla"),
-        layout=params.get("layout", "dense"),
-        presort=params.get_bool("presort", False),
-        steps_per_call=params.get_int("steps-per-call", 1),
-    )
     uf = np.asarray(res.worker_state)
     itf = np.asarray(res.store.values())
     if data is not None:

@@ -30,6 +30,14 @@ from .core.transform import (
     transform_with_model_load,
 )
 from .parallel.mesh import DP_AXIS, PS_AXIS, make_mesh
+from .resilience import (
+    FaultPlan,
+    HealthMonitor,
+    RecoveringDriver,
+    RestartPolicy,
+    StallWatchdog,
+    UpdateWAL,
+)
 from .serving import (
     QueryEngine,
     ServingClient,
@@ -74,4 +82,10 @@ __all__ = [
     "ServingServer",
     "ServingService",
     "SnapshotManager",
+    "UpdateWAL",
+    "RecoveringDriver",
+    "RestartPolicy",
+    "FaultPlan",
+    "HealthMonitor",
+    "StallWatchdog",
 ]

@@ -22,6 +22,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import socket
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -35,11 +36,32 @@ def socket_text_stream(
     errors: str = "replace",
     connect_timeout: float = 10.0,
     max_line_bytes: int = 1 << 20,
+    reconnect: bool = True,
+    max_reconnects: int = 8,
+    backoff_base_s: float = 0.05,
+    backoff_cap_s: float = 5.0,
+    backoff_seed: int = 0,
 ) -> Iterator[str]:
     """Yield newline-delimited lines from a TCP server until the peer
-    closes.  The trailing partial line (no newline before EOF) is
-    yielded too — matching file semantics, so a line-oriented producer
-    never silently loses its last record.
+    closes CLEANLY.  The trailing partial line (no newline before EOF)
+    is yielded too — matching file semantics, so a line-oriented
+    producer never silently loses its last record.
+
+    Reconnect (``reconnect=True``, the default): an ABRUPT connection
+    failure — reset, broken pipe, timeout, refused connect — no longer
+    kills the unbounded job it feeds (the exact weakness the reference's
+    socket jobs had); the stream re-dials with capped exponential
+    backoff + full jitter, up to ``max_reconnects`` CONSECUTIVE failed
+    attempts (the streak resets once a reconnected socket delivers
+    bytes), then raises the last error.  The returned iterator exposes
+    a ``reconnects`` counter (successful re-dials — the observability
+    hook, like ``batches_from_records.dropped``).  A clean peer close
+    (EOF) is still the explicit end-of-stream — never retried.  A
+    partial line buffered when the connection drops abruptly is
+    DISCARDED (its tail is unrecoverable; a half-record must not be
+    yielded as a record) — producers that need exactly-once should
+    sequence-number their lines.  ``reconnect=False`` preserves the old
+    die-on-error behavior.
 
     ``errors="replace"`` (the default) maps undecodable bytes to U+FFFD
     instead of raising: one corrupt byte must not kill an unbounded
@@ -50,26 +72,111 @@ def socket_text_stream(
 
     ``max_line_bytes`` bounds the reassembly buffer: a producer that
     never sends a newline would otherwise grow it without limit."""
-    with socket.create_connection((host, port), timeout=connect_timeout) as s:
-        # liveness beats latency here: the batcher downstream absorbs
-        # jitter, so no artificial read timeout once connected
-        s.settimeout(None)
-        buf = b""
+    return _SocketLineStream(
+        host, port, encoding=encoding, errors=errors,
+        connect_timeout=connect_timeout, max_line_bytes=max_line_bytes,
+        reconnect=reconnect, max_reconnects=max_reconnects,
+        backoff_base_s=backoff_base_s, backoff_cap_s=backoff_cap_s,
+        backoff_seed=backoff_seed,
+    )
+
+
+class _SocketLineStream:
+    """Iterator with a visible ``reconnects`` counter (the socket-side
+    sibling of ``_RecordBatcher.dropped``)."""
+
+    def __init__(self, host, port, *, encoding, errors, connect_timeout,
+                 max_line_bytes, reconnect, max_reconnects,
+                 backoff_base_s, backoff_cap_s, backoff_seed):
+        self.reconnects = 0
+        self._gen = self._run(
+            host, port, encoding, errors, connect_timeout, max_line_bytes,
+            reconnect, max_reconnects, backoff_base_s, backoff_cap_s,
+            backoff_seed,
+        )
+
+    def _run(self, host, port, encoding, errors, connect_timeout,
+             max_line_bytes, reconnect, max_reconnects, backoff_base_s,
+             backoff_cap_s, backoff_seed):
+        rng = np.random.default_rng(backoff_seed)
+        failures = 0  # consecutive failed dial/read attempts
+        connected_once = False
         while True:
-            chunk = s.recv(1 << 16)
-            if not chunk:
-                break
-            buf += chunk
-            if len(buf) > max_line_bytes and b"\n" not in buf:
-                raise ValueError(
-                    f"socket line exceeded {max_line_bytes} bytes with no "
-                    f"newline — not a line-delimited stream?"
+            try:
+                s = socket.create_connection(
+                    (host, port), timeout=connect_timeout
                 )
-            *lines, buf = buf.split(b"\n")
-            for ln in lines:
-                yield ln.decode(encoding, errors)
-        if buf:
-            yield buf.decode(encoding, errors)
+            except OSError as e:
+                if not reconnect:
+                    raise
+                failures += 1
+                if failures > max_reconnects:
+                    raise ConnectionError(
+                        f"socket source gave up after {max_reconnects} "
+                        f"consecutive failed reconnect attempts to "
+                        f"{host}:{port}"
+                    ) from e
+                time.sleep(self._backoff(failures, backoff_base_s,
+                                         backoff_cap_s, rng))
+                continue
+            if connected_once:
+                self.reconnects += 1
+            connected_once = True
+            buf = b""
+            got_bytes = False
+            try:
+                with s:
+                    # liveness beats latency here: the batcher downstream
+                    # absorbs jitter, so no artificial read timeout once
+                    # connected
+                    s.settimeout(None)
+                    while True:
+                        chunk = s.recv(1 << 16)
+                        if not chunk:
+                            # clean EOF: the EXPLICIT end-of-stream —
+                            # flush the trailing partial line and stop
+                            if buf:
+                                yield buf.decode(encoding, errors)
+                            return
+                        got_bytes = True
+                        failures = 0  # live again: reset the streak
+                        buf += chunk
+                        if len(buf) > max_line_bytes and b"\n" not in buf:
+                            raise ValueError(
+                                f"socket line exceeded {max_line_bytes} "
+                                f"bytes with no newline — not a "
+                                f"line-delimited stream?"
+                            )
+                        *lines, buf = buf.split(b"\n")
+                        for ln in lines:
+                            yield ln.decode(encoding, errors)
+            except OSError as e:
+                if not reconnect:
+                    raise
+                # abrupt death mid-stream: drop the partial line (its
+                # tail is gone), back off, re-dial
+                if not got_bytes:
+                    failures += 1
+                if failures > max_reconnects:
+                    raise ConnectionError(
+                        f"socket source gave up after {max_reconnects} "
+                        f"consecutive failed reconnect attempts to "
+                        f"{host}:{port}"
+                    ) from e
+                time.sleep(self._backoff(max(1, failures), backoff_base_s,
+                                         backoff_cap_s, rng))
+
+    @staticmethod
+    def _backoff(attempt, base, cap, rng):
+        # capped exponential with full jitter (decorrelates a fleet of
+        # consumers re-dialing one recovered producer)
+        return float(rng.uniform(0.0, min(cap, base * (2 ** (attempt - 1)))))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._gen)
 
 
 def batches_from_records(

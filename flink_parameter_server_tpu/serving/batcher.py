@@ -175,5 +175,14 @@ class RequestBatcher:
                     )
             self._cond.notify_all()
 
+    def reopen(self) -> None:
+        """Resume admission after :meth:`close` — the supervised-restart
+        path (``ServingService.start`` on a service that was stopped):
+        a restarted trainer re-attaching its serving plane must not
+        inherit a permanently-closed admission queue."""
+        with self._cond:
+            self._closed = False
+            self._cond.notify_all()
+
 
 __all__ = ["QueueFull", "RequestBatcher", "PendingRequest", "pow2_bucket"]

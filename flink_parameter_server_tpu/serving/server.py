@@ -80,8 +80,16 @@ class ServingService:
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.metrics.queue_depth_fn = lambda: self.batcher.depth
         self.metrics.staleness_fn = self.snapshots.staleness
+        self.dispatch_errors = 0  # batches failed wholesale (loop survived)
+        self._health = None  # optional resilience/health.HealthMonitor
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+    def attach_health(self, monitor) -> "ServingService":
+        """Beat ``serving_dispatch`` on ``monitor`` from the dispatch
+        loop (resilience/health.py stall watchdog wiring)."""
+        self._health = monitor
+        return self
 
     @classmethod
     def for_spec(
@@ -109,6 +117,11 @@ class ServingService:
     def start(self) -> "ServingService":
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
+            # restart path: a previous stop() closed the admission
+            # queue; a restarted trainer re-attaching serving (the
+            # supervisor's resume, or an explicit stop/start cycle)
+            # gets a live one again
+            self.batcher.reopen()
             self._thread = threading.Thread(
                 target=self._loop, name="serving-dispatch", daemon=True
             )
@@ -187,8 +200,21 @@ class ServingService:
     def _loop(self) -> None:
         while not self._stop.is_set():
             batch = self.batcher.next_batch(timeout=0.1)
-            if batch:
+            if self._health is not None:
+                self._health.beat("serving_dispatch")
+            if not batch:
+                continue
+            try:
                 self._serve_batch(batch)
+            except BaseException as e:
+                # One poisoned batch must not kill the dispatch thread —
+                # with it dead, every later query hangs to its timeout
+                # while the trainer keeps publishing to nobody.  Fail
+                # the batch's futures, count it, keep serving.
+                self.dispatch_errors += 1
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(e)
 
     def _serve_batch(self, batch: List[PendingRequest]) -> None:
         topks = [p for p in batch if isinstance(p.payload, _TopKQuery)]

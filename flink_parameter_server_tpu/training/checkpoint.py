@@ -254,17 +254,50 @@ class JobCheckpointManager:
     def restore_latest(
         self, spec: StoreSpec, worker_state_shardings: Any = None
     ) -> Optional[Tuple[ShardedParamStore, Any, Dict[str, Any]]]:
-        step = self.latest_step()
-        if step is None:
+        """Restore the newest RESTORABLE retained step.
+
+        A corrupt/partial latest checkpoint (crash mid-write outside
+        orbax's atomic-commit path, bit rot, a chaos test's garbling)
+        must not kill the recovery it exists to serve: on a restore
+        failure we warn and fall back to the next older retained step —
+        losing one checkpoint interval beats losing the job (the WAL, if
+        configured, still replays the difference).  Only when every
+        retained step fails does the error propagate."""
+        import warnings
+
+        steps = self.all_steps()
+        if not steps:
             return None
-        # explicit StandardRestore: a FRESH manager (the resume path —
-        # a new driver on an existing directory) has no handler
-        # registered for the saved "default" item and raises KeyError
-        # on an argless restore
-        payload = self._mgr.restore(
-            step, args=_ocp().args.StandardRestore()
-        )
-        return _payload_to_state(payload, spec, worker_state_shardings)
+        last_exc: Optional[BaseException] = None
+        for step in reversed(steps):
+            try:
+                # explicit StandardRestore: a FRESH manager (the resume
+                # path — a new driver on an existing directory) has no
+                # handler registered for the saved "default" item and
+                # raises KeyError on an argless restore
+                payload = self._mgr.restore(
+                    step, args=_ocp().args.StandardRestore()
+                )
+                state = _payload_to_state(
+                    payload, spec, worker_state_shardings
+                )
+            except BaseException as e:  # orbax raises a zoo of types
+                # (ValueError, KeyError, FileNotFoundError, proto/zarr
+                # decode errors) for a bad step dir — all mean the same
+                # thing here: this step is not a usable recovery point
+                last_exc = e
+                warnings.warn(
+                    f"checkpoint step {step} failed to restore "
+                    f"({type(e).__name__}: {e}); falling back to the "
+                    f"previous retained step",
+                    RuntimeWarning,
+                )
+                continue
+            return state
+        raise RuntimeError(
+            f"no retained checkpoint step under {self._directory!r} is "
+            f"restorable (tried {list(reversed(steps))})"
+        ) from last_exc
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -32,7 +32,16 @@ from .tracing import profile_trace
 
 
 class TrainingDiverged(RuntimeError):
-    """Raised by the driver's NaN guard (DriverConfig.nan_check_every)."""
+    """Raised by the driver's NaN guard (DriverConfig.nan_check_every).
+
+    ``step`` carries the dispatch-boundary step the guard fired at — the
+    supervisor (``resilience/recovery.py``) needs it to size the input
+    window it must skip (the window *caused* the divergence; replaying
+    it would re-diverge deterministically)."""
+
+    def __init__(self, message: str, step: int = 0):
+        super().__init__(message)
+        self.step = step
 
 
 def _all_finite(*trees) -> jax.Array:
@@ -95,6 +104,15 @@ class DriverConfig:
     # installed only for the duration of run() (main thread only) and
     # the previous handlers are restored after.
     stop_signals: tuple = ()
+    # Write-ahead update log (resilience/wal.py): every microbatch
+    # consumed from the source is appended (on the ingest edge, BEFORE
+    # the step applies it) and each checkpoint save truncates the log —
+    # recovery replays checkpoint + tail instead of losing the window.
+    # None = off (zero cost).
+    wal_dir: Optional[str] = None
+    wal_segment_bytes: int = 16 << 20
+    wal_fsync_every: int = 1  # records between fsyncs; 0 = never
+    wal_max_bytes: Optional[int] = None  # soft budget (warns when over)
 
 
 class StreamingDriver:
@@ -115,6 +133,7 @@ class StreamingDriver:
         config: Optional[DriverConfig] = None,
         rng: Optional[jax.Array] = None,
         metrics_sink=None,
+        health=None,
     ):
         self.logic = logic
         self.store = store
@@ -127,6 +146,22 @@ class StreamingDriver:
         self._pending_skip = 0
         self._stop_requested = False
         self._serving = None
+        # resilience wiring: an optional HealthMonitor beaten from the
+        # ingest and train threads (resilience/health.py), user group
+        # hooks (chaos injection and friends), and the update WAL
+        self.health = health
+        self._group_hooks = []
+        self._last_ckpt_step: Optional[int] = None
+        self._wal = None
+        if self.config.wal_dir is not None:
+            from ..resilience.wal import UpdateWAL
+
+            self._wal = UpdateWAL(
+                self.config.wal_dir,
+                segment_bytes=self.config.wal_segment_bytes,
+                fsync_every=self.config.wal_fsync_every,
+                max_bytes=self.config.wal_max_bytes,
+            )
         self._ckpt_mgr: Optional[ckpt.JobCheckpointManager] = None
         if self.config.checkpoint_dir is not None:
             self._ckpt_mgr = ckpt.JobCheckpointManager(
@@ -147,6 +182,33 @@ class StreamingDriver:
         # checkpointed (orbax otherwise silently skips duplicate steps)
         self._ckpt_mgr.save(self.step_idx, self.store, self._state, force=True)
         self._ckpt_mgr.wait()  # the explicit save() contract is durable
+        if self._wal is not None:
+            # same one-checkpoint lag as the periodic path: the last
+            # interval's WAL stays as the corrupt-latest fallback's
+            # replay source (it is one interval of bytes — cheap).
+            # Anchor on the RETAINED steps, not the in-memory tracker:
+            # a close-time save re-saving the final periodic step would
+            # otherwise truncate through itself and strip the fallback's
+            # coverage.  (all_steps waits, but so did the save above.)
+            steps = self._ckpt_mgr.all_steps()
+            if len(steps) >= 2:
+                self._wal.truncate_through(steps[-2])
+        self._last_ckpt_step = self.step_idx
+
+    @property
+    def wal(self):
+        """The driver's UpdateWAL (None unless config.wal_dir is set) —
+        the supervisor's replay handle."""
+        return self._wal
+
+    def add_group_hook(self, hook) -> None:
+        """Register ``hook(global_step, n_steps, table, state, outs)``,
+        called once per jitted dispatch on the training thread, after
+        the dispatch's updates were applied and before the checkpoint /
+        NaN cadences run.  This is the injection point chaos testing
+        uses (resilience/chaos.py) and the place operator-side
+        instrumentation hangs without forking the loop."""
+        self._group_hooks.append(hook)
 
     def request_stop(self) -> None:
         """Programmatic preemption: the current ``run`` stops feeding
@@ -181,6 +243,10 @@ class StreamingDriver:
             raise ValueError(
                 "pass either a prebuilt service or for_spec kwargs, not both"
             )
+        if self.health is not None:
+            # one monitor spans the stack: ingest + train beats come
+            # from this driver, serving-dispatch beats from the service
+            service.attach_health(self.health)
         self._serving = service
         return service
 
@@ -236,6 +302,20 @@ class StreamingDriver:
                         event_counts.append(int(np.asarray(b["mask"]).sum()))
                     else:
                         event_counts.append(len(jax.tree.leaves(b)[0]))
+                    if self._wal is not None:
+                        # WRITE-AHEAD: durable before the step applies
+                        # it (this runs on the ingest/prefetch thread,
+                        # ahead of the dispatch that consumes the
+                        # batch).  Step numbering matches group_callback
+                        # below; appends are idempotent by step, so a
+                        # recovery replay re-feeding logged batches
+                        # through this same path is a no-op.
+                        self._wal.append(
+                            start_step - skip + n, 1,
+                            jax.tree.map(np.asarray, b),
+                        )
+                    if self.health is not None:
+                        self.health.beat("ingest")
                 yield b
 
         it = counting(iter(data), skip)
@@ -279,11 +359,19 @@ class StreamingDriver:
                 self.metrics.step_end(events, n_steps=n_steps)
                 self.metrics.step_start()
             self.step_idx = global_step
+            if self.health is not None:
+                self.health.beat("train")
             if self._serving is not None:
                 # snapshot publish (copy-on-publish, cadence-gated) runs
                 # on THIS thread, so the copy is sequenced before the
                 # next dispatch donates the table buffer
                 self._serving.on_dispatch(table, state, global_step)
+            for hook in self._group_hooks:
+                # user/chaos hooks see the applied dispatch before the
+                # checkpoint cadence runs — a hook that raises here
+                # models the worst-case crash point (updates applied,
+                # boundary's checkpoint not yet taken)
+                hook(global_step, n_steps, table, state, outs)
 
             def crossed(every):
                 # did (prev_global, global_step] cross a multiple of
@@ -317,7 +405,9 @@ class StreamingDriver:
                 # (K, ...)-stacked — the reduction covers every step.
                 if not bool(_all_finite(outs, table, state)):
                     raise TrainingDiverged(
-                        f"non-finite step output/params at step {global_step}"
+                        f"non-finite step output/params at step "
+                        f"{global_step}",
+                        step=global_step,
                     )
             if crossed(cfg.metrics_every):
                 self.metrics.emit(self.metrics_sink)
@@ -335,6 +425,19 @@ class StreamingDriver:
                     self._ckpt_mgr.save(
                         global_step, ShardedParamStore(spec, table), state
                     )
+                    if self._wal is not None and self._last_ckpt_step is not None:
+                        # Bound the WAL at the checkpoint cadence —
+                        # lagging ONE checkpoint behind, deliberately:
+                        # (a) an async save is still in flight here
+                        # (truncating through it would wait() and
+                        # de-async the loop; the previous one is durable
+                        # because orbax serializes async saves), and
+                        # (b) if the newest checkpoint proves corrupt at
+                        # restore time, restore_latest falls back one
+                        # step and the kept WAL interval still replays
+                        # the difference — corrupt-latest stays lossless.
+                        self._wal.truncate_through(self._last_ckpt_step)
+                    self._last_ckpt_step = global_step
 
         prev_handlers = {}
         if cfg.stop_signals:
