@@ -737,6 +737,56 @@ def _emit_recovery_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_telemetry_summary(platform: str, fallback: bool) -> None:
+    """Fourth (opt-in) metric line: the unified-registry roll-up.
+
+    FPS_BENCH_TELEMETRY=1 builds the cross-component run report from
+    the process-wide MetricsRegistry — which the serving and recovery
+    bench lines populated through their driver/serving runs — prints it
+    as one JSON line, and writes ``results/<platform>/run_report.{md,
+    json}`` (docs/perf_status.md: future bench deltas cite that file).
+    Default 0: the headline lines stay byte-stable for existing
+    consumers."""
+    raw = os.environ.get("FPS_BENCH_TELEMETRY", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_TELEMETRY={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "telemetry summary (unified registry roll-up)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from flink_parameter_server_tpu.telemetry import (
+            build_run_report,
+            write_run_report,
+        )
+
+        report = build_run_report()
+        paths = write_run_report(report, platform=platform)
+        print(json.dumps({
+            "metric": metric,
+            "value": report["train"]["steps"],
+            "unit": "train steps observed",
+            "extra": {
+                "run_id": report["run_id"],
+                "train": report["train"],
+                "serving": report["serving"],
+                "ingest": report["ingest"],
+                "recovery": report["recovery"],
+                "run_report_json": os.path.relpath(
+                    paths["json"], os.path.dirname(os.path.abspath(__file__))
+                ),
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "train steps observed",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -761,6 +811,7 @@ def main():
             # artifact replay
             _emit_serving_metric(platform, fallback)
             _emit_recovery_metric(platform, fallback)
+            _emit_telemetry_summary(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -812,6 +863,7 @@ def main():
     print(json.dumps(payload))
     _emit_serving_metric(platform, fallback)
     _emit_recovery_metric(platform, fallback)
+    _emit_telemetry_summary(platform, fallback)
 
 
 if __name__ == "__main__":

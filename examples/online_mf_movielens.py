@@ -10,6 +10,13 @@ Usage (ParameterTool-style args — utils/config.py):
         [--dim 32] [--lr 0.05] [--epochs 3] [--batch 4096]
         [--scatter xla|pallas|xla_sorted] [--layout dense|packed|auto]
         [--presort 0|1] [--steps-per-call 1] [--chaos SEED]
+        [--telemetry-port P]
+
+``--telemetry-port P`` serves the unified metrics plane live while the
+job trains (``telemetry/``, docs/observability.md): ``curl
+http://127.0.0.1:P/metrics`` answers Prometheus text (step counters,
+pull→push latency histogram, heartbeat ages), ``/healthz`` the
+heartbeat view.  ``P=0`` binds an ephemeral port (printed at start).
 
 ``--chaos SEED`` demonstrates the resilience layer end to end: a
 seeded FaultPlan crashes the job mid-training, and a RecoveringDriver
@@ -114,6 +121,48 @@ def _run_with_chaos(params, make_stream, *, num_users, num_items, mesh):
     return res
 
 
+def _run_with_driver(params, stream, *, num_users, num_items, mesh):
+    """The --telemetry-port path: same MF job, run under the
+    StreamingDriver envelope so the unified plane is live (step/event
+    counters, pull→push latency histogram, ingest counters, host-side
+    spans — all scrapeable on /metrics while this trains)."""
+    from flink_parameter_server_tpu.core.store import ShardedParamStore
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+    from flink_parameter_server_tpu.training.driver import (
+        DriverConfig,
+        StreamingDriver,
+    )
+    from flink_parameter_server_tpu.utils.initializers import (
+        ranged_random_factor,
+    )
+
+    dim = params.get_int("dim", 32)
+    logic = OnlineMatrixFactorization(
+        num_users, dim,
+        updater=SGDUpdater(params.get_float("lr", 0.05)),
+        mesh=mesh,
+    )
+    store = ShardedParamStore.create(
+        num_items, (dim,),
+        init_fn=ranged_random_factor(1, (dim,)),
+        mesh=mesh,
+        scatter_impl=params.get("scatter", "xla"),
+        layout=params.get("layout", "dense"),
+    )
+    driver = StreamingDriver(
+        logic, store,
+        config=DriverConfig(
+            dump_model=False,
+            presort=params.get_bool("presort", False),
+            steps_per_call=params.get_int("steps-per-call", 1),
+        ),
+    )
+    return driver.run(stream)
+
+
 def main():
     params = Parameters.from_env().merged_with(
         Parameters.from_args(sys.argv[1:])
@@ -149,6 +198,18 @@ def main():
     mesh = None
     if len(jax.devices()) > 1:
         mesh = make_mesh()  # all devices on dp; ps=1
+
+    telemetry_server = None
+    if "telemetry-port" in params:
+        from flink_parameter_server_tpu.telemetry import TelemetryServer
+
+        telemetry_server = TelemetryServer(
+            port=params.get_int("telemetry-port", 0)
+        ).start()
+        print(
+            f"telemetry live: http://{telemetry_server.host}:"
+            f"{telemetry_server.port}/metrics (and /healthz)"
+        )
 
     if sock:
         from flink_parameter_server_tpu.data.socket import (
@@ -198,6 +259,15 @@ def main():
             params, make_stream, num_users=num_users, num_items=num_items,
             mesh=mesh,
         )
+    elif telemetry_server is not None:
+        # the telemetry demo runs through the StreamingDriver — the
+        # plane's instruments (step counters, pull→push histogram,
+        # ingest counters, spans) live on the driver envelope, which
+        # the bare ps_online_mf/transform_batched loop bypasses
+        res = _run_with_driver(
+            params, stream, num_users=num_users, num_items=num_items,
+            mesh=mesh,
+        )
     else:
         res = ps_online_mf(
             stream,
@@ -225,6 +295,8 @@ def main():
         print(f"socket stream ended; malformed records dropped: "
               f"{stream.dropped}")
     print(f"user factors {uf.shape}, item factors {itf.shape}")
+    if telemetry_server is not None:
+        telemetry_server.stop()
 
 
 if __name__ == "__main__":

@@ -45,6 +45,16 @@ from .serving import (
     ServingService,
     SnapshotManager,
 )
+from .telemetry import (
+    MetricsRegistry,
+    SpanTracer,
+    TelemetryServer,
+    build_run_report,
+    get_registry,
+    get_tracer,
+    prometheus_text,
+    write_run_report,
+)
 from .training.driver import DriverConfig, StreamingDriver
 
 __version__ = "0.1.0"
@@ -88,4 +98,12 @@ __all__ = [
     "FaultPlan",
     "HealthMonitor",
     "StallWatchdog",
+    "MetricsRegistry",
+    "SpanTracer",
+    "TelemetryServer",
+    "get_registry",
+    "get_tracer",
+    "prometheus_text",
+    "build_run_report",
+    "write_run_report",
 ]

@@ -121,6 +121,13 @@ class _SocketLineStream:
                 continue
             if connected_once:
                 self.reconnects += 1
+                # unified plane: re-dials are a recovery signal the
+                # run report rolls up (telemetry/report.py "reconnects")
+                from ..telemetry.registry import get_registry
+
+                get_registry().counter(
+                    "ingest_reconnects_total", component="ingest"
+                ).inc()
             connected_once = True
             buf = b""
             got_bytes = False

@@ -22,10 +22,11 @@ attached must not page about the serving heartbeat).
 """
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional
+
+from ..telemetry.registry import get_registry, json_line
 
 # canonical component names (any string works; these are what the
 # driver/serving wiring uses, and what tests/docs refer to)
@@ -36,19 +37,45 @@ SERVING = "serving_dispatch"
 
 class HealthMonitor:
     """Thread-safe last-beat registry: ``beat(name)`` on the component's
-    own thread, ``age(name)``/``stalled(threshold)`` from anywhere."""
+    own thread, ``age(name)``/``stalled(threshold)`` from anywhere.
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    Heartbeats also route through the unified telemetry plane: the
+    first beat of each component registers a live probe gauge
+    ``last_heartbeat_age_s{component=...}`` on ``registry`` (default:
+    the process-wide one), so a stall is VISIBLE on ``/metrics`` — the
+    age climbing scrape over scrape — before the watchdog fires.
+    ``registry=False`` opts out (pure-unit tests with fake clocks)."""
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        *,
+        registry=None,
+    ):
         self._clock = clock
         self._lock = threading.Lock()
         self._last: Dict[str, float] = {}
         self._beats: Dict[str, int] = {}
+        self._registry = registry
+        self._gauged: set = set()
 
     def beat(self, component: str) -> None:
         now = self._clock()
         with self._lock:
             self._last[component] = now
             self._beats[component] = self._beats.get(component, 0) + 1
+            first = component not in self._gauged
+            if first:
+                self._gauged.add(component)
+        if first and self._registry is not False:
+            reg = (
+                self._registry if self._registry is not None
+                else get_registry()
+            )
+            reg.gauge(
+                "last_heartbeat_age_s", component=component,
+                fn=lambda c=component: self.age(c),
+            )
 
     def components(self) -> List[str]:
         with self._lock:
@@ -94,6 +121,7 @@ class StallWatchdog:
         on_stall: Optional[Callable[[str, float], None]] = None,
         poll_s: Optional[float] = None,
         sink=None,
+        registry=None,
     ):
         if stall_after_s <= 0:
             raise ValueError(f"stall_after_s={stall_after_s}: must be > 0")
@@ -104,6 +132,10 @@ class StallWatchdog:
             float(poll_s) if poll_s is not None else self.stall_after_s / 4
         )
         self.sink = sink
+        # unified plane: each stall episode also bumps
+        # stall_episodes_total{component=<stalled>} (registry=False
+        # opts out; None = the process-wide default)
+        self._registry = registry
         self.events: List[dict] = []
         self._tripped: set = set()  # components in an open stall episode
         self._stop = threading.Event()
@@ -156,8 +188,18 @@ class StallWatchdog:
                 else:
                     self._tripped.discard(comp)
         for event in new_events:
+            if self._registry is not False:
+                reg = (
+                    self._registry if self._registry is not None
+                    else get_registry()
+                )
+                reg.counter(
+                    "stall_episodes_total", component=event["stall"]
+                ).inc()
             if self.sink is not None:
-                self.sink.write(json.dumps(event) + "\n")
+                # one-JSON-per-episode stays; the line now carries the
+                # shared ts/run_id like every other emitter
+                json_line(event, self.sink)
             if self.on_stall is not None:
                 self.on_stall(event["stall"], event["age_s"])
         return new_events

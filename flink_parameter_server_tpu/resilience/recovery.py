@@ -34,12 +34,13 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-import json
 import time
 from typing import Any, Callable, Iterable, List, Optional
 
 import numpy as np
 
+from ..telemetry.registry import get_registry, json_line
+from ..telemetry.spans import get_tracer
 from ..training.driver import StreamingDriver, TrainingDiverged
 
 
@@ -157,6 +158,7 @@ class RecoveringDriver:
         *,
         policy: Optional[RestartPolicy] = None,
         metrics_sink=None,
+        registry=None,
     ):
         self.driver = driver
         self.data_factory = data_factory
@@ -168,6 +170,12 @@ class RecoveringDriver:
         self.steps_dropped = 0
         self._extra_skip = 0  # input batches dropped forever (divergence)
         self._rng = np.random.default_rng(self.policy.seed)
+        # unified plane: restart/backoff/replay episodes publish under
+        # component=recovery (counters here, spans around the recover
+        # path) alongside the per-restart JSON event line
+        self._registry = (
+            registry if registry is not None else get_registry()
+        )
 
     # -- the supervision loop ----------------------------------------------
     def run(self, collect_outputs: bool = False, **run_kwargs) -> Any:
@@ -208,9 +216,12 @@ class RecoveringDriver:
                     ) from exc
                 backoff = self.policy.backoff_s(attempt, self._rng)
                 event["backoff_s"] = round(backoff, 4)
+                tracer = get_tracer()
                 if backoff > 0:
-                    time.sleep(backoff)
-                self._recover(fc, exc, event)
+                    with tracer.span("backoff", component="recovery"):
+                        time.sleep(backoff)
+                with tracer.span("recover", component="recovery"):
+                    self._recover(fc, exc, event)
                 self.restarts += 1
                 self._record(event)
 
@@ -289,8 +300,25 @@ class RecoveringDriver:
 
     def _record(self, event: dict) -> None:
         self.events.append(event)
+        reg = self._registry
+        if reg is not False:
+            if "gave_up" not in event:  # a gave-up attempt never restarted
+                reg.counter(
+                    "recovery_restarts_total", component="recovery",
+                    failure=event["failure"],
+                ).inc()
+            if event.get("replayed_steps"):
+                reg.counter(
+                    "recovery_replayed_steps_total", component="recovery"
+                ).inc(event["replayed_steps"])
+            if event.get("dropped_steps"):
+                reg.counter(
+                    "recovery_dropped_steps_total", component="recovery"
+                ).inc(event["dropped_steps"])
         if self.metrics_sink is not None:
-            self.metrics_sink.write(json.dumps(event) + "\n")
+            # one JSON line per restart, now stamped with the shared
+            # ts/run_id (same contract as every other emitter)
+            json_line(event, self.metrics_sink)
 
 
 __all__ = [

@@ -11,12 +11,13 @@ snapshot staleness (trainer steps the served table lags the live one).
 """
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
+
+from ..telemetry.registry import json_line
 
 
 class ServingMetrics:
@@ -26,9 +27,16 @@ class ServingMetrics:
     snapshots.  ``queue_depth_fn`` / ``staleness_fn`` are live probes
     wired in by the :class:`~.server.ServingService` so emission reads
     the CURRENT queue/staleness, not a stale recorded value.
+
+    With a :class:`~..telemetry.MetricsRegistry` attached
+    (``registry=``, or :meth:`bind_registry` after construction), the
+    admission counters (requests / batches / rejects), the
+    admission→answer latency histogram, and live probe gauges (QPS,
+    fill, queue depth, staleness) publish through the unified plane
+    under ``component=serving``.
     """
 
-    def __init__(self, window: int = 1024):
+    def __init__(self, window: int = 1024, registry=None):
         self.window = int(window)
         self._lock = threading.Lock()
         self._latencies: List[float] = []  # seconds, admission -> answer
@@ -40,6 +48,47 @@ class ServingMetrics:
         self.started_at = time.perf_counter()
         self.queue_depth_fn: Optional[Callable[[], int]] = None
         self.staleness_fn: Optional[Callable[[], Optional[int]]] = None
+        self.registry = None
+        self._c_requests = self._c_batches = None
+        self._c_rejected = self._h_latency = None
+        if registry is not None:
+            self.bind_registry(registry)
+
+    def bind_registry(self, registry) -> "ServingMetrics":
+        """Publish through the unified plane.  Called by
+        :class:`~.server.ServingService` AFTER the queue/staleness
+        probes are wired, so the gauges read live values from day one."""
+        self.registry = registry
+        self._c_requests = registry.counter(
+            "serving_requests_total", component="serving"
+        )
+        self._c_batches = registry.counter(
+            "serving_batches_total", component="serving"
+        )
+        self._c_rejected = registry.counter(
+            "serving_rejected_total", component="serving"
+        )
+        self._h_latency = registry.histogram(
+            "serving_latency_seconds", component="serving"
+        )
+        registry.gauge("serving_qps", component="serving", fn=self.qps)
+        registry.gauge(
+            "serving_batch_fill", component="serving", fn=self.batch_fill
+        )
+        registry.gauge(
+            "serving_queue_depth", component="serving",
+            fn=lambda: (
+                None if self.queue_depth_fn is None
+                else self.queue_depth_fn()
+            ),
+        )
+        registry.gauge(
+            "snapshot_staleness_steps", component="serving",
+            fn=lambda: (
+                None if self.staleness_fn is None else self.staleness_fn()
+            ),
+        )
+        return self
 
     # -- recording ---------------------------------------------------------
     def record_batch(
@@ -55,10 +104,17 @@ class ServingMetrics:
             for buf in (self._latencies, self._fills, self._done_times):
                 if len(buf) > self.window:
                     del buf[: len(buf) - self.window]
+        if self._c_requests is not None:
+            self._c_requests.inc(n)
+            self._c_batches.inc()
+            for lat in latencies_s:
+                self._h_latency.observe(lat)
 
     def record_reject(self, n: int = 1) -> None:
         with self._lock:
             self.total_rejected += n
+        if self._c_rejected is not None:
+            self._c_rejected.inc(n)
 
     # -- reporting ---------------------------------------------------------
     def qps(self) -> float:
@@ -107,10 +163,12 @@ class ServingMetrics:
         return out
 
     def emit(self, sink=None) -> str:
-        line = json.dumps(self.snapshot())
-        if sink is not None:
-            sink.write(line + "\n")
-        return line
+        """One single-line JSON sample (shared ``ts``/``run_id`` stamped
+        by the unified plane; guaranteed to round-trip ``json.loads``)."""
+        return json_line(
+            self.snapshot(), sink,
+            run_id=self.registry.run_id if self.registry else None,
+        )
 
 
 __all__ = ["ServingMetrics"]

@@ -73,6 +73,7 @@ class ServingService:
         engine: QueryEngine,
         batcher: Optional[RequestBatcher] = None,
         metrics: Optional[ServingMetrics] = None,
+        registry=None,
     ):
         self.engine = engine
         self.snapshots = engine.snapshots
@@ -80,6 +81,18 @@ class ServingService:
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.metrics.queue_depth_fn = lambda: self.batcher.depth
         self.metrics.staleness_fn = self.snapshots.staleness
+        # unified plane (telemetry/): admission counters, the latency
+        # histogram, and live depth/fill/staleness probe gauges publish
+        # under component=serving — bound AFTER the probes above so the
+        # gauges are live from the first scrape.  Default: the
+        # process-wide registry (one /metrics endpoint sees the whole
+        # train-while-serve stack).
+        from ..telemetry import get_registry
+
+        if registry is not None:
+            self.metrics.bind_registry(registry)
+        elif self.metrics.registry is None:
+            self.metrics.bind_registry(get_registry())
         self.dispatch_errors = 0  # batches failed wholesale (loop survived)
         self._health = None  # optional resilience/health.HealthMonitor
         self._thread: Optional[threading.Thread] = None

@@ -1,0 +1,52 @@
+"""Unified telemetry plane (docs/observability.md).
+
+One registry, one tracer, one live endpoint, one end-of-run report —
+the seam every subsystem (train / serving / ingest / recovery) measures
+through, and the seam every later perf PR is judged through:
+
+  * :mod:`.registry` — typed instruments (Counter, Gauge, Histogram)
+    with ``component=`` labels; process-wide default via
+    :func:`get_registry`; JSON-lines ``emit`` with shared ts/run_id.
+  * :mod:`.spans` — nestable wall-clock spans, ring-buffered, Chrome
+    trace-event export; the HOST-side complement of
+    ``training/tracing.py``'s device-side ``jax.named_scope``.
+  * :mod:`.exporter` — Prometheus-text rendering + the TCP
+    ``/metrics`` / ``/healthz`` endpoint (live during training).
+  * :mod:`.report` — ``results/<platform>/run_report.{md,json}``.
+"""
+from .exporter import TelemetryServer, prometheus_text, scrape
+from .registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_run_id,
+    get_registry,
+    json_line,
+    set_registry,
+)
+from .report import build_run_report, render_markdown, write_run_report
+from .spans import SpanTracer, get_tracer, set_tracer, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "DEFAULT_BUCKETS",
+    "default_run_id",
+    "json_line",
+    "get_registry",
+    "set_registry",
+    "SpanTracer",
+    "get_tracer",
+    "set_tracer",
+    "span",
+    "TelemetryServer",
+    "prometheus_text",
+    "scrape",
+    "build_run_report",
+    "render_markdown",
+    "write_run_report",
+]

@@ -1,0 +1,258 @@
+"""Live metrics surface: Prometheus-text exposition + a tiny TCP
+endpoint.
+
+Symmetric to ``serving/server.py``: the serve path answers queries over
+a newline-delimited TCP socket, the telemetry path answers scrapes over
+one.  The server speaks enough HTTP/1.0 for ``curl`` and a Prometheus
+scrape job (``GET /metrics``, ``GET /healthz``), and also answers the
+bare line protocol (``metrics\\n`` / ``healthz\\n``) so a test or a
+shell one-liner (``nc``) needs no HTTP client.  One thread per
+connection, one response per request, connection closed after — a
+scrape surface, not a serving plane.
+
+Elastic-aggregation work (arXiv:2204.03211, PAPERS.md) assumes exactly
+this: a queryable live parameter-service metrics surface that external
+controllers poll to make scaling decisions.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import List, Optional
+
+from .registry import Histogram, MetricsRegistry, get_registry
+
+# metric names go out namespaced; label values get minimal escaping
+_PREFIX = "fps_"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n"
+    )
+
+
+def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + body + "}"
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "NaN"  # Prometheus-legal marker for an unreadable gauge
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus exposition format (0.0.4).
+
+    Counters get the conventional ``_total`` suffix (unless already
+    named that way); histograms expand to cumulative ``_bucket{le=}``
+    series plus ``_sum``/``_count``."""
+    reg = registry if registry is not None else get_registry()
+    by_name: dict = {}
+    for inst in reg.instruments():
+        by_name.setdefault(inst.name, []).append(inst)
+    lines: List[str] = []
+    for name in sorted(by_name):
+        insts = by_name[name]
+        kind = insts[0].kind
+        out_name = _PREFIX + name
+        if kind == "counter" and not out_name.endswith("_total"):
+            out_name += "_total"
+        lines.append(f"# TYPE {out_name} {kind}")
+        for inst in insts:
+            if isinstance(inst, Histogram):
+                counts = inst.bucket_counts()
+                cum = 0
+                for bound, c in zip(inst.bounds, counts):
+                    cum += c
+                    lines.append(
+                        f"{out_name}_bucket"
+                        f"{_fmt_labels(inst.labels, {'le': repr(float(bound))})}"
+                        f" {cum}"
+                    )
+                cum += counts[-1]
+                lines.append(
+                    f"{out_name}_bucket"
+                    f"{_fmt_labels(inst.labels, {'le': '+Inf'})} {cum}"
+                )
+                lines.append(
+                    f"{out_name}_sum{_fmt_labels(inst.labels)} "
+                    f"{_fmt_value(inst.sum)}"
+                )
+                lines.append(
+                    f"{out_name}_count{_fmt_labels(inst.labels)} "
+                    f"{inst.count}"
+                )
+            else:
+                lines.append(
+                    f"{out_name}{_fmt_labels(inst.labels)} "
+                    f"{_fmt_value(inst.value)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+class TelemetryServer:
+    """``GET /metrics`` (Prometheus text) + ``GET /healthz`` (JSON) over
+    TCP, serving LIVE registry values while training runs.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port``).
+    ``health`` is an optional ``resilience.HealthMonitor``: with one
+    attached, ``/healthz`` reports per-component heartbeat ages and
+    degrades ``status`` to ``"stalled"`` past ``stall_after_s`` — the
+    watchdog's view, scrapeable before the watchdog fires.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        health=None,
+        stall_after_s: Optional[float] = None,
+        max_request_bytes: int = 8192,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.health = health
+        self.stall_after_s = stall_after_s
+        self.max_request_bytes = int(max_request_bytes)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "TelemetryServer":
+        if self._accept_thread is None or not self._accept_thread.is_alive():
+            self._stop.clear()
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name="telemetry-accept",
+                daemon=True,
+            )
+            self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+            self._accept_thread = None
+
+    def __enter__(self) -> "TelemetryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- request handling --------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(5.0)
+            buf = b""
+            # one request line is enough; drain headers best-effort so
+            # an HTTP client's request doesn't RST on early close
+            while b"\n" not in buf and len(buf) < self.max_request_bytes:
+                chunk = conn.recv(4096)
+                if not chunk:
+                    return
+                buf += chunk
+            first = buf.split(b"\n", 1)[0].decode(
+                "utf-8", "replace"
+            ).strip()
+            http = first.upper().startswith(("GET ", "HEAD "))
+            path = first.split()[1] if http and len(
+                first.split()
+            ) >= 2 else first
+            path = path.strip().lstrip("/").lower() or "metrics"
+            if path.startswith("metrics"):
+                body = prometheus_text(self.registry)
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = "200 OK"
+            elif path.startswith("healthz"):
+                body = json.dumps(self._healthz()) + "\n"
+                ctype = "application/json"
+                status = "200 OK"
+            else:
+                body = f"unknown path {path!r} (metrics|healthz)\n"
+                ctype = "text/plain; charset=utf-8"
+                status = "404 Not Found"
+            payload = body.encode("utf-8")
+            if http:
+                head = (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n"
+                    f"Connection: close\r\n\r\n"
+                ).encode("ascii")
+                conn.sendall(head + payload)
+            else:
+                conn.sendall(payload)
+        except OSError:
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _healthz(self) -> dict:
+        out = {"status": "ok", "run_id": self.registry.run_id}
+        if self.health is not None:
+            ages = self.health.ages()
+            out["heartbeat_age_s"] = {
+                c: round(a, 3) for c, a in sorted(ages.items())
+            }
+            if self.stall_after_s is not None:
+                stalled = self.health.stalled(self.stall_after_s)
+                if stalled:
+                    out["status"] = "stalled"
+                    out["stalled"] = stalled
+        return out
+
+
+def scrape(host: str, port: int, path: str = "metrics",
+           timeout: float = 5.0) -> str:
+    """One-shot line-protocol scrape (test/shell helper): send the bare
+    path, read to EOF, return the body."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(path.strip().encode("utf-8") + b"\n")
+        chunks = []
+        while True:
+            c = s.recv(1 << 16)
+            if not c:
+                break
+            chunks.append(c)
+    return b"".join(chunks).decode("utf-8", "replace")
+
+
+__all__ = ["prometheus_text", "TelemetryServer", "scrape"]

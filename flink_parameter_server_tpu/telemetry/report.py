@@ -1,0 +1,200 @@
+"""End-of-run report builder.
+
+One page per run, not a log to grep: steps/sec, pull→push latency
+percentiles, serving QPS/p99, snapshot staleness, ingest reconnects,
+recovery episodes — pulled from the unified registry and written to
+``results/<platform>/run_report.{md,json}``.  docs/perf_status.md's
+rule: future bench deltas cite ``run_report.json``, so every number
+here carries enough context (run_id, platform, wall clock) to be
+compared across rounds without re-deriving provenance.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+
+def _find(snapshot: Dict[str, Any], name: str, **labels) -> Optional[Any]:
+    """First sample of ``name`` whose labels include ``labels``."""
+    for sample in snapshot.get(name, ()):
+        if all(sample["labels"].get(k) == v for k, v in labels.items()):
+            return sample["value"]
+    return None
+
+
+def _sum_counter(snapshot: Dict[str, Any], name: str) -> float:
+    return float(
+        sum(s["value"] or 0.0 for s in snapshot.get(name, ()))
+    )
+
+
+def _hist_percentiles(registry: MetricsRegistry, name: str) -> Dict[str, Any]:
+    for inst in registry.instruments():
+        if inst.name == name and inst.kind == "histogram" and inst.count:
+            return {
+                "p50_ms": round(inst.percentile(50) * 1e3, 3),
+                "p99_ms": round(inst.percentile(99) * 1e3, 3),
+                "mean_ms": round(inst.sum / inst.count * 1e3, 3),
+                "count": inst.count,
+            }
+    return {"p50_ms": None, "p99_ms": None, "mean_ms": None, "count": 0}
+
+
+def build_run_report(
+    registry: Optional[MetricsRegistry] = None,
+    *,
+    wall_s: Optional[float] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Assemble the cross-component summary dict from the registry.
+
+    ``wall_s`` overrides the elapsed-time base for the steps/sec rate
+    (callers that know the measured window pass it; the default is time
+    since the registry was created).  ``extra`` merges verbatim under
+    ``"extra"`` — the telemetry-overhead bench records its A/B there.
+    """
+    reg = registry if registry is not None else get_registry()
+    snap = reg.snapshot()
+    wall = float(wall_s) if wall_s is not None else max(
+        1e-9, time.time() - reg.created_at
+    )
+    steps = _sum_counter(snap, "train_steps_total")
+    events = _sum_counter(snap, "train_events_total")
+    report: Dict[str, Any] = {
+        "run_id": reg.run_id,
+        "generated_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "wall_s": round(wall, 3),
+        "train": {
+            "steps": int(steps),
+            "events": int(events),
+            "steps_per_sec": round(steps / wall, 2),
+            "updates_per_sec": round(events / wall, 1),
+            "pull_push": _hist_percentiles(reg, "pull_push_latency_seconds"),
+            "checkpoints": int(_sum_counter(snap, "checkpoints_total")),
+        },
+        "serving": {
+            "requests": int(_sum_counter(snap, "serving_requests_total")),
+            "rejected": int(_sum_counter(snap, "serving_rejected_total")),
+            "qps": _find(snap, "serving_qps", component="serving"),
+            "latency": _hist_percentiles(reg, "serving_latency_seconds"),
+            "batch_fill": _find(
+                snap, "serving_batch_fill", component="serving"
+            ),
+            "snapshot_staleness_steps": _find(
+                snap, "snapshot_staleness_steps", component="serving"
+            ),
+        },
+        "ingest": {
+            "batches": int(_sum_counter(snap, "ingest_batches_total")),
+            "reconnects": int(
+                _sum_counter(snap, "ingest_reconnects_total")
+            ),
+            "wal_appends": int(_sum_counter(snap, "wal_appends_total")),
+        },
+        "recovery": {
+            "restarts": int(
+                _sum_counter(snap, "recovery_restarts_total")
+            ),
+            "replayed_steps": int(
+                _sum_counter(snap, "recovery_replayed_steps_total")
+            ),
+            "dropped_steps": int(
+                _sum_counter(snap, "recovery_dropped_steps_total")
+            ),
+            "stall_episodes": int(
+                _sum_counter(snap, "stall_episodes_total")
+            ),
+        },
+    }
+    if extra:
+        report["extra"] = dict(extra)
+    return report
+
+
+def _default_platform() -> str:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def render_markdown(report: Dict[str, Any]) -> str:
+    t, s = report["train"], report["serving"]
+    i, r = report["ingest"], report["recovery"]
+    pp, sl = t["pull_push"], s["latency"]
+
+    def fmt(v, unit=""):
+        return "—" if v is None else f"{v}{unit}"
+
+    lines = [
+        "# Run report",
+        "",
+        f"run `{report['run_id']}` · generated {report['generated_at']} "
+        f"· wall {report['wall_s']} s",
+        "",
+        "| metric | value |",
+        "|---|---|",
+        f"| train steps | {t['steps']} |",
+        f"| steps/sec | {t['steps_per_sec']} |",
+        f"| updates/sec | {t['updates_per_sec']} |",
+        f"| pull→push p50 / p99 | {fmt(pp['p50_ms'], ' ms')} / "
+        f"{fmt(pp['p99_ms'], ' ms')} |",
+        f"| checkpoints | {t['checkpoints']} |",
+        f"| serving requests (rejected) | {s['requests']} "
+        f"({s['rejected']}) |",
+        f"| serving QPS | {fmt(s['qps'])} |",
+        f"| serving p50 / p99 | {fmt(sl['p50_ms'], ' ms')} / "
+        f"{fmt(sl['p99_ms'], ' ms')} |",
+        f"| snapshot staleness (steps) | "
+        f"{fmt(s['snapshot_staleness_steps'])} |",
+        f"| ingest batches / reconnects | {i['batches']} / "
+        f"{i['reconnects']} |",
+        f"| WAL appends | {i['wal_appends']} |",
+        f"| recovery restarts / replayed / dropped | {r['restarts']} / "
+        f"{r['replayed_steps']} / {r['dropped_steps']} |",
+        f"| stall episodes | {r['stall_episodes']} |",
+    ]
+    extra = report.get("extra")
+    if extra:
+        lines += ["", "## Extra", ""]
+        for k in sorted(extra):
+            lines.append(f"- `{k}`: {extra[k]}")
+    return "\n".join(lines) + "\n"
+
+
+def write_run_report(
+    report: Dict[str, Any],
+    *,
+    platform: Optional[str] = None,
+    results_dir: Optional[str] = None,
+) -> Dict[str, str]:
+    """Write ``run_report.md`` + ``run_report.json`` under
+    ``results/<platform>/`` (repo-relative by default) and return the
+    two paths."""
+    if results_dir is None:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        results_dir = os.path.join(
+            repo, "results", platform or _default_platform()
+        )
+    os.makedirs(results_dir, exist_ok=True)
+    json_path = os.path.join(results_dir, "run_report.json")
+    md_path = os.path.join(results_dir, "run_report.md")
+    with open(json_path, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    with open(md_path, "w") as f:
+        f.write(render_markdown(report))
+    return {"json": json_path, "md": md_path}
+
+
+__all__ = ["build_run_report", "render_markdown", "write_run_report"]

@@ -1,0 +1,193 @@
+"""Wall-clock span tracer — host-side phase attribution.
+
+``training/tracing.py`` covers the DEVICE side (``jax.named_scope``
+annotations inside the jitted step, Perfetto/XPlane traces).  What it
+cannot see is where the HOST went: ingest wait, WAL fsync, snapshot
+publish, dispatch queueing — precisely the silent stalls the straggler
+study (arXiv:2308.15482) blames for PS throughput loss.  This tracer
+makes those visible next to the device steps: nestable ``span("pull")``
+context managers, a fixed-size ring buffer (old spans fall off; tracing
+a week-long job must not OOM the host), and a Chrome trace-event JSON
+export (``chrome://tracing`` / Perfetto ``ui.perfetto.dev`` both load
+it) so the host timeline sits beside the profiler's device timeline.
+
+Overhead discipline: a disabled tracer's ``span()`` returns a shared
+no-op context manager — two attribute reads, no allocation — so the
+driver can leave the call sites in place unconditionally.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "component", "t0")
+
+    def __init__(self, tracer: "SpanTracer", name: str, component: str):
+        self.tracer = tracer
+        self.name = name
+        self.component = component
+
+    def __enter__(self):
+        self.tracer._stack().append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        stack = self.tracer._stack()
+        depth = len(stack) - 1
+        stack.pop()
+        self.tracer._record(
+            self.name, self.component, self.t0, t1, depth
+        )
+        return False
+
+
+class SpanTracer:
+    """Ring-buffered wall-clock tracer.
+
+    Spans nest per-thread (a ``publish`` inside a ``dispatch`` carries
+    depth 1); the buffer holds the most recent ``capacity`` spans across
+    all threads.  ``export_chrome_trace()`` emits the standard
+    trace-event JSON array of complete (``ph: "X"``) events — depth is
+    preserved implicitly by Chrome's per-tid flame stacking and
+    explicitly in each event's ``args.depth``.
+    """
+
+    def __init__(self, capacity: int = 65536, *, enabled: bool = True):
+        if capacity <= 0:
+            raise ValueError(f"capacity={capacity}: must be > 0")
+        self.capacity = int(capacity)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=self.capacity)
+        self._local = threading.local()
+        # perf_counter has an arbitrary epoch; anchor it to wall time
+        # once so exported timestamps are meaningful across processes
+        self._epoch_wall = time.time()
+        self._epoch_perf = time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _record(self, name: str, component: str, t0: float, t1: float,
+                depth: int) -> None:
+        with self._lock:
+            self._spans.append(
+                (name, component, t0, t1, depth, threading.get_ident())
+            )
+
+    def span(self, name: str, component: str = "host"):
+        """``with tracer.span("ingest", component="ingest"): ...`` —
+        returns the shared no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, component)
+
+    def record(self, name: str, t0: float, t1: float,
+               component: str = "host") -> None:
+        """Retroactive span from already-taken ``time.perf_counter()``
+        stamps — for intervals whose boundaries live in someone else's
+        control flow (the driver times dispatches at callback edges;
+        wrapping the jitted call itself would mean forking the loop)."""
+        if not self.enabled:
+            return
+        self._record(name, component, float(t0), float(t1), 0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    # -- reads -------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Recorded spans, oldest first: name/component/start/dur/depth/
+        tid (seconds, perf_counter timebase)."""
+        with self._lock:
+            raw = list(self._spans)
+        return [
+            {
+                "name": n, "component": c, "start": t0,
+                "dur": t1 - t0, "depth": d, "tid": tid,
+            }
+            for (n, c, t0, t1, d, tid) in raw
+        ]
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON (the array form — both catapult and
+        Perfetto accept it).  Timestamps are microseconds since the
+        tracer's wall-clock epoch; writes to ``path`` when given,
+        returns the JSON string either way."""
+        events = []
+        with self._lock:
+            raw = list(self._spans)
+        for (name, component, t0, t1, depth, tid) in raw:
+            events.append({
+                "name": name,
+                "cat": component,
+                "ph": "X",
+                "ts": round((t0 - self._epoch_perf) * 1e6, 3),
+                "dur": round((t1 - t0) * 1e6, 3),
+                "pid": 0,
+                "tid": tid,
+                "args": {"depth": depth},
+            })
+        doc = json.dumps(events)
+        if path is not None:
+            with open(path, "w") as f:
+                f.write(doc)
+        return doc
+
+
+# -- the process-wide default -------------------------------------------------
+_DEFAULT_LOCK = threading.Lock()
+_DEFAULT: Optional[SpanTracer] = None
+
+
+def get_tracer() -> SpanTracer:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = SpanTracer()
+        return _DEFAULT
+
+
+def set_tracer(tracer: Optional[SpanTracer]) -> None:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = tracer
+
+
+def span(name: str, component: str = "host"):
+    """Module-level convenience over the default tracer."""
+    return get_tracer().span(name, component)
+
+
+__all__ = ["SpanTracer", "get_tracer", "set_tracer", "span"]

@@ -16,6 +16,7 @@ loop implementation, hooked — not duplicated):
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Iterable, Optional
 
 import jax
@@ -26,6 +27,8 @@ from ..core.batched import BatchedWorkerLogic
 from ..core.store import ShardedParamStore
 from ..core.transform import TransformResult, transform_batched
 from ..data.streams import prefetch as prefetch_iter
+from ..telemetry.registry import get_registry
+from ..telemetry.spans import SpanTracer, get_tracer
 from . import checkpoint as ckpt
 from .metrics import StepMetrics
 from .tracing import profile_trace
@@ -113,6 +116,15 @@ class DriverConfig:
     wal_segment_bytes: int = 16 << 20
     wal_fsync_every: int = 1  # records between fsyncs; 0 = never
     wal_max_bytes: Optional[int] = None  # soft budget (warns when over)
+    # Unified telemetry plane (telemetry/): step/event counters, the
+    # pull→push latency histogram and live gauges publish to the
+    # process-wide MetricsRegistry (scrapeable via TelemetryServer
+    # while the run is live), and the host-side phases — ingest wait,
+    # WAL append, the pull/compute/push dispatch, snapshot publish,
+    # checkpoint save — are recorded as wall-clock spans on the default
+    # SpanTracer (Chrome-trace exportable).  False = zero-touch (the
+    # overhead A/B lever; tests/test_telemetry.py guards the cost).
+    telemetry: bool = True
 
 
 class StreamingDriver:
@@ -134,6 +146,7 @@ class StreamingDriver:
         rng: Optional[jax.Array] = None,
         metrics_sink=None,
         health=None,
+        registry=None,
     ):
         self.logic = logic
         self.store = store
@@ -141,6 +154,18 @@ class StreamingDriver:
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.metrics_sink = metrics_sink
         self.metrics: Optional[StepMetrics] = None
+        # telemetry plane: an explicit registry always wins; otherwise
+        # the process-wide default when config.telemetry, else nothing.
+        # The tracer mirrors the same switch (a disabled tracer's
+        # span() is a shared no-op — call sites stay unconditional).
+        if registry is not None:
+            self.registry = registry
+        else:
+            self.registry = get_registry() if self.config.telemetry else None
+        self.tracer = (
+            get_tracer() if self.config.telemetry
+            else SpanTracer(capacity=1, enabled=False)
+        )
         self.step_idx = 0
         self._state = None
         self._pending_skip = 0
@@ -180,8 +205,15 @@ class StreamingDriver:
             return
         # force: an explicit save must land even if this step was already
         # checkpointed (orbax otherwise silently skips duplicate steps)
-        self._ckpt_mgr.save(self.step_idx, self.store, self._state, force=True)
-        self._ckpt_mgr.wait()  # the explicit save() contract is durable
+        with self.tracer.span("checkpoint", component="train"):
+            self._ckpt_mgr.save(
+                self.step_idx, self.store, self._state, force=True
+            )
+            self._ckpt_mgr.wait()  # the explicit save() contract is durable
+        if self.registry is not None:
+            self.registry.counter(
+                "checkpoints_total", component="train"
+            ).inc()
         if self._wal is not None:
             # same one-checkpoint lag as the periodic path: the last
             # interval's WAL stays as the corrupt-latest fallback's
@@ -290,18 +322,40 @@ class StreamingDriver:
 
         event_counts: "collections.deque" = collections.deque()
 
+        tracer = self.tracer
+        c_ingest = c_wal = None
+        if self.registry is not None:
+            c_ingest = self.registry.counter(
+                "ingest_batches_total", component="ingest"
+            )
+            c_wal = self.registry.counter(
+                "wal_appends_total", component="ingest"
+            )
+
         def counting(source, skipped):
-            for n, b in enumerate(source):
+            src = iter(source)
+            n = 0
+            while True:
                 if self._stop_requested:
                     # preemption: stop feeding; the batches already in
                     # the prefetch queue drain, then the loop closes
                     # normally (close-time save below persists the state)
                     return
+                # the span makes a frozen source VISIBLE on the host
+                # timeline: a long `ingest` bar next to idle dispatches
+                # is the straggler study's signature stall shape
+                with tracer.span("ingest", component="ingest"):
+                    try:
+                        b = next(src)
+                    except StopIteration:
+                        return
                 if n >= skipped:  # skipped batches never reach the callback
                     if "mask" in b:
                         event_counts.append(int(np.asarray(b["mask"]).sum()))
                     else:
                         event_counts.append(len(jax.tree.leaves(b)[0]))
+                    if c_ingest is not None:
+                        c_ingest.inc()
                     if self._wal is not None:
                         # WRITE-AHEAD: durable before the step applies
                         # it (this runs on the ingest/prefetch thread,
@@ -310,12 +364,16 @@ class StreamingDriver:
                         # below; appends are idempotent by step, so a
                         # recovery replay re-feeding logged batches
                         # through this same path is a no-op.
-                        self._wal.append(
-                            start_step - skip + n, 1,
-                            jax.tree.map(np.asarray, b),
-                        )
+                        with tracer.span("wal_append", component="ingest"):
+                            self._wal.append(
+                                start_step - skip + n, 1,
+                                jax.tree.map(np.asarray, b),
+                            )
+                        if c_wal is not None:
+                            c_wal.inc()
                     if self.health is not None:
                         self.health.beat("ingest")
+                n += 1
                 yield b
 
         it = counting(iter(data), skip)
@@ -325,6 +383,12 @@ class StreamingDriver:
         sync_steps = cfg.metrics_every > 0
         trace_ctx = {"cm": None}
         first_step_of_run = [True]
+        # dispatch-span boundary: from here (or the previous callback's
+        # exit) to the next callback's entry is one pull→compute→push
+        # dispatch window as the HOST experiences it — recorded
+        # retroactively because the jitted call itself lives inside
+        # transform_batched (wrapping it would mean forking the loop)
+        t_boundary = [time.perf_counter()]
 
         def group_callback(first_idx, n_steps, table, state, outs):
             # One invocation per jitted DISPATCH (n_steps == 1 when
@@ -332,6 +396,12 @@ class StreamingDriver:
             # per-step state_callback; n_steps == K for scanned groups,
             # where cadences round up to the boundary: between scanned
             # steps there is no host-visible table to act on).
+            if sync_steps:
+                jax.block_until_ready(outs)
+            tracer.record(
+                "pull_compute_push", t_boundary[0], time.perf_counter(),
+                component="train",
+            )
             prev_global = start_step - skip + first_idx
             global_step = prev_global + n_steps
             events = sum(
@@ -340,7 +410,8 @@ class StreamingDriver:
             )
             if self.metrics is None:
                 self.metrics = StepMetrics(
-                    events_per_step=events // max(1, n_steps)
+                    events_per_step=events // max(1, n_steps),
+                    registry=self.registry,
                 )
             if first_step_of_run[0]:
                 # this run's first dispatch start was never timestamped
@@ -348,12 +419,9 @@ class StreamingDriver:
                 # inter-run idle time into the latency window) — count,
                 # don't time
                 first_step_of_run[0] = False
-                self.metrics.total_steps += n_steps
-                self.metrics.total_events += events
+                self.metrics.count_untimed(n_steps, events)
                 self.metrics.step_start()
             else:
-                if sync_steps:
-                    jax.block_until_ready(outs)
                 # latency percentiles time DISPATCHES (n_steps steps
                 # each); totals still count steps and events exactly
                 self.metrics.step_end(events, n_steps=n_steps)
@@ -365,7 +433,8 @@ class StreamingDriver:
                 # snapshot publish (copy-on-publish, cadence-gated) runs
                 # on THIS thread, so the copy is sequenced before the
                 # next dispatch donates the table buffer
-                self._serving.on_dispatch(table, state, global_step)
+                with tracer.span("publish", component="train"):
+                    self._serving.on_dispatch(table, state, global_step)
             for hook in self._group_hooks:
                 # user/chaos hooks see the applied dispatch before the
                 # checkpoint cadence runs — a hook that raises here
@@ -422,9 +491,15 @@ class StreamingDriver:
                 # host copy and writes in the background), so donation is
                 # safe either way.
                 if self._ckpt_mgr is not None:
-                    self._ckpt_mgr.save(
-                        global_step, ShardedParamStore(spec, table), state
-                    )
+                    with tracer.span("checkpoint", component="train"):
+                        self._ckpt_mgr.save(
+                            global_step, ShardedParamStore(spec, table),
+                            state,
+                        )
+                    if self.registry is not None:
+                        self.registry.counter(
+                            "checkpoints_total", component="train"
+                        ).inc()
                     if self._wal is not None and self._last_ckpt_step is not None:
                         # Bound the WAL at the checkpoint cadence —
                         # lagging ONE checkpoint behind, deliberately:
@@ -438,6 +513,9 @@ class StreamingDriver:
                         # the difference — corrupt-latest stays lossless.
                         self._wal.truncate_through(self._last_ckpt_step)
                     self._last_ckpt_step = global_step
+            # next dispatch's span starts AFTER this callback's overhead
+            # (publish/hooks/checkpoint carry their own spans)
+            t_boundary[0] = time.perf_counter()
 
         prev_handlers = {}
         if cfg.stop_signals:
@@ -504,9 +582,11 @@ class StreamingDriver:
         if self._serving is not None:
             # close-time publish: post-run queries answer from the FINAL
             # table (the serve-path analogue of the §3.5 model flush)
-            self._serving.on_dispatch(
-                self.store.table, self._state, self.step_idx, force=True
-            )
+            with tracer.span("publish", component="train"):
+                self._serving.on_dispatch(
+                    self.store.table, self._state, self.step_idx,
+                    force=True,
+                )
         self.save()
         return result
 

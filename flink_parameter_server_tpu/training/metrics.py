@@ -5,15 +5,23 @@ only Flink's operator metrics (throughput, backpressure).  The rebuild's
 north-star metrics (BASELINE.md) are measured here: updates/sec/chip and
 pull→push latency percentiles, plus a JSON-lines emitter as the
 "accumulator" analogue.
+
+With a :class:`~..telemetry.MetricsRegistry` attached the tracker also
+publishes through the unified plane (``component=train``): step/event
+counters, the pull→push latency histogram, and a live updates/sec
+probe gauge — which is what the ``/metrics`` endpoint scrapes while
+the run is in flight.  The JSON emit line stays (same keys, now
+stamped with the shared ``ts``/``run_id``).
 """
 from __future__ import annotations
 
-import json
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from ..telemetry.registry import json_line
 
 
 @dataclass
@@ -28,12 +36,43 @@ class StepMetrics:
 
     events_per_step: int
     window: int = 100
+    registry: Optional[Any] = None  # telemetry.MetricsRegistry or None
     _durations: List[float] = field(default_factory=list)
     _window_events: List[int] = field(default_factory=list)
     _t_last: Optional[float] = None
     total_steps: int = 0
     total_events: int = 0
     started_at: float = field(default_factory=time.perf_counter)
+
+    def __post_init__(self) -> None:
+        reg = self.registry
+        self._c_steps = self._c_events = self._h_latency = None
+        if reg is not None:
+            self._c_steps = reg.counter(
+                "train_steps_total", component="train"
+            )
+            self._c_events = reg.counter(
+                "train_events_total", component="train"
+            )
+            self._h_latency = reg.histogram(
+                "pull_push_latency_seconds", component="train"
+            )
+            # probe gauge: the scrape reads the CURRENT windowed rate,
+            # at zero per-step cost
+            reg.gauge(
+                "updates_per_sec", component="train",
+                fn=self.updates_per_sec,
+            )
+
+    def count_untimed(self, steps: int, events: int) -> None:
+        """Count steps/events that were never timed (a run's first
+        dispatch has no prior timestamp; recovery bookkeeping) — totals
+        and registry counters stay exact, latency stays honest."""
+        self.total_steps += steps
+        self.total_events += events
+        if self._c_steps is not None:
+            self._c_steps.inc(steps)
+            self._c_events.inc(events)
 
     def step_start(self) -> None:
         self._t_last = time.perf_counter()
@@ -49,13 +88,20 @@ class StepMetrics:
         step/event totals and the rate stay exact."""
         assert self._t_last is not None, "step_start() not called"
         n_events = self.events_per_step * n_steps if events is None else events
-        self._durations.append(time.perf_counter() - self._t_last)
+        dur = time.perf_counter() - self._t_last
+        self._durations.append(dur)
         self._window_events.append(n_events)
         if len(self._durations) > self.window:
             self._durations.pop(0)
             self._window_events.pop(0)
         self.total_steps += n_steps
         self.total_events += n_events
+        if self._c_steps is not None:
+            self._c_steps.inc(n_steps)
+            self._c_events.inc(n_events)
+            # one observation per DISPATCH (n_steps steps), matching the
+            # percentile semantics of the rolling window
+            self._h_latency.observe(dur)
 
     # -- reporting --------------------------------------------------------
     def updates_per_sec(self) -> float:
@@ -86,10 +132,12 @@ class StepMetrics:
         }
 
     def emit(self, sink=None) -> str:
-        line = json.dumps(self.snapshot())
-        if sink is not None:
-            sink.write(line + "\n")
-        return line
+        """One single-line JSON sample (shared ``ts``/``run_id`` stamped
+        by the unified plane; guaranteed to round-trip ``json.loads``)."""
+        return json_line(
+            self.snapshot(), sink,
+            run_id=self.registry.run_id if self.registry else None,
+        )
 
 
 __all__ = ["StepMetrics"]

@@ -19,6 +19,8 @@ TOP_LEVEL = [
     "Pull", "Push", "PullAnswer", "WorkerToPS", "PSToWorker",
     "ServingService", "ServingClient", "ServingServer", "QueryEngine",
     "SnapshotManager",
+    "MetricsRegistry", "SpanTracer", "TelemetryServer", "get_registry",
+    "get_tracer", "prometheus_text", "build_run_report", "write_run_report",
 ]
 
 MODULE_SYMBOLS = {
@@ -35,7 +37,17 @@ MODULE_SYMBOLS = {
         "save", "restore", "load_model", "JobCheckpointManager"],
     "flink_parameter_server_tpu.training.metrics": ["StepMetrics"],
     "flink_parameter_server_tpu.training.tracing": [
-        "profile_trace", "scope", "device_memory_stats"],
+        "profile_trace", "scope", "device_memory_stats",
+        "register_device_memory_gauges"],
+    "flink_parameter_server_tpu.telemetry.registry": [
+        "Counter", "Gauge", "Histogram", "MetricsRegistry", "json_line",
+        "get_registry", "set_registry"],
+    "flink_parameter_server_tpu.telemetry.spans": [
+        "SpanTracer", "get_tracer", "set_tracer", "span"],
+    "flink_parameter_server_tpu.telemetry.exporter": [
+        "prometheus_text", "TelemetryServer", "scrape"],
+    "flink_parameter_server_tpu.telemetry.report": [
+        "build_run_report", "render_markdown", "write_run_report"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",

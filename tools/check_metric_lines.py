@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Lint for metric-line streams (the JSON-lines sink contract).
+
+Every emitter in the repo — StepMetrics, ServingMetrics, the stall
+watchdog, the recovery supervisor, the registry itself — writes ONE
+valid single-line JSON object per sample, stamped with the shared
+``ts``/``run_id`` fields (telemetry/registry.py ``json_line``).  This
+tool enforces that contract over captured logs, so a malformed line is
+caught in CI (tests/test_telemetry.py invokes it over a live example
+run) instead of by a downstream parser at 3 a.m.
+
+Usage::
+
+    python tools/check_metric_lines.py run.log [more.log ...]
+    some_job 2>&1 | python tools/check_metric_lines.py -
+
+Lines that are empty or start with ``#`` (bench commentary) are
+skipped; everything else must ``json.loads`` to a dict carrying ``ts``
+(number) and ``run_id`` (string).  ``--allow-missing-ids`` relaxes the
+ts/run_id requirement (pre-telemetry logs).  Exit 0 = clean, 1 = at
+least one malformed line (each is reported with file:line and reason).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable, List, Tuple
+
+
+def check_lines(
+    lines: Iterable[str], *, require_ids: bool = True
+) -> List[Tuple[int, str, str]]:
+    """Return ``[(lineno, reason, line), ...]`` for malformed lines
+    (1-based line numbers; empty list = clean)."""
+    bad = []
+    for i, raw in enumerate(lines, 1):
+        line = raw.rstrip("\n")
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        try:
+            obj = json.loads(stripped)
+        except ValueError as e:
+            bad.append((i, f"not valid JSON: {e}", line))
+            continue
+        if not isinstance(obj, dict):
+            bad.append((i, f"not a JSON object (got {type(obj).__name__})",
+                        line))
+            continue
+        if "\n" in stripped:  # unreachable via splitlines; belt+braces
+            bad.append((i, "spans multiple lines", line))
+            continue
+        if require_ids:
+            ts = obj.get("ts")
+            if not isinstance(ts, (int, float)):
+                bad.append((i, "missing/non-numeric 'ts'", line))
+                continue
+            if not isinstance(obj.get("run_id"), str):
+                bad.append((i, "missing/non-string 'run_id'", line))
+    return bad
+
+
+def main(argv: List[str]) -> int:
+    require_ids = True
+    paths = []
+    for a in argv:
+        if a == "--allow-missing-ids":
+            require_ids = False
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        else:
+            paths.append(a)
+    if not paths:
+        print("usage: check_metric_lines.py [--allow-missing-ids] "
+              "<file|-> ...", file=sys.stderr)
+        return 2
+    failed = False
+    for path in paths:
+        if path == "-":
+            lines = sys.stdin.read().splitlines()
+            name = "<stdin>"
+        else:
+            with open(path) as f:
+                lines = f.read().splitlines()
+            name = path
+        bad = check_lines(lines, require_ids=require_ids)
+        for lineno, reason, line in bad:
+            failed = True
+            shown = line if len(line) <= 120 else line[:117] + "..."
+            print(f"{name}:{lineno}: {reason}: {shown}", file=sys.stderr)
+        print(f"{name}: {len(lines)} lines, {len(bad)} malformed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
