@@ -787,6 +787,66 @@ def _emit_telemetry_summary(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_cluster_metric(platform: str, fallback: bool) -> None:
+    """Fifth (opt-in) metric line: the multi-shard cluster runtime.
+
+    FPS_BENCH_CLUSTER=1 runs the 1/2/4-shard scaling sweep
+    (benchmarks/cluster_scaling.py, thread-backed shards over real TCP)
+    and writes ``results/<platform>/cluster_scaling.{md,json}`` — the
+    artifact docs/perf_status.md requires any scaling claim to cite.
+    Default 0: the sweep costs tens of seconds and the headline lines
+    stay byte-stable for existing consumers.  Same guard discipline as
+    the other lines: failure degrades to a value-None line."""
+    raw = os.environ.get("FPS_BENCH_CLUSTER", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_CLUSTER={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "cluster scaling (multi-shard PS, online MF)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.cluster_scaling import run_cluster_bench
+
+        r = run_cluster_bench(
+            rounds=12,
+            batch=1_024,
+            num_items=4_096,
+            dim=16,
+            num_workers=2,
+        )
+        arms = r["arms"]
+        best = max(a["updates_per_sec"] for a in arms)
+        print(json.dumps({
+            "metric": metric,
+            "value": best,
+            "unit": "updates/sec (best arm)",
+            "extra": {
+                "arms": [
+                    {
+                        "num_shards": a["num_shards"],
+                        "updates_per_sec": a["updates_per_sec"],
+                        "pull_p50_ms": a["pull_p50_ms"],
+                        "pull_p99_ms": a["pull_p99_ms"],
+                    }
+                    for a in arms
+                ],
+                "num_workers": r["num_workers"],
+                "staleness_bound": r["staleness_bound"],
+                "batch": r["batch"],
+                "rounds": r["rounds"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "updates/sec (best arm)",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -812,6 +872,7 @@ def main():
             _emit_serving_metric(platform, fallback)
             _emit_recovery_metric(platform, fallback)
             _emit_telemetry_summary(platform, fallback)
+            _emit_cluster_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -864,6 +925,7 @@ def main():
     _emit_serving_metric(platform, fallback)
     _emit_recovery_metric(platform, fallback)
     _emit_telemetry_summary(platform, fallback)
+    _emit_cluster_metric(platform, fallback)
 
 
 if __name__ == "__main__":

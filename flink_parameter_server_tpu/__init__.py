@@ -29,6 +29,16 @@ from .core.transform import (
     transform_batched,
     transform_with_model_load,
 )
+from .cluster import (
+    ClusterClient,
+    ClusterConfig,
+    ClusterDriver,
+    ConsistentHashPartitioner,
+    ParamShard,
+    RangePartitioner,
+    ShardServer,
+    StalenessClock,
+)
 from .parallel.mesh import DP_AXIS, PS_AXIS, make_mesh
 from .resilience import (
     FaultPlan,

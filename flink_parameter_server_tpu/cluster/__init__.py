@@ -1,0 +1,36 @@
+"""cluster/ — the multi-shard parameter-server runtime.
+
+The source paper's defining topology, made real: several PS shard
+processes holding key-partitioned state (:mod:`.shard`), workers
+exchanging asynchronous pull/push messages against them over TCP
+(:mod:`.client`), deterministic key→shard maps (:mod:`.partition`),
+and a bounded-staleness clock spanning BSP → SSP → fully-async
+(:mod:`.clock`).  :class:`~.driver.ClusterDriver` wires a topology
+around any :class:`~..core.batched.BatchedWorkerLogic` and trains the
+same jobs the single-process :class:`~..training.driver.StreamingDriver`
+runs.  See docs/cluster.md.
+"""
+from .client import ClusterClient, ShardConnection
+from .clock import StalenessClock
+from .driver import ClusterConfig, ClusterDriver, ClusterResult
+from .partition import (
+    ConsistentHashPartitioner,
+    Partitioner,
+    RangePartitioner,
+)
+from .shard import ParamShard, ShardCrashed, ShardServer
+
+__all__ = [
+    "ClusterClient",
+    "ClusterConfig",
+    "ClusterDriver",
+    "ClusterResult",
+    "ConsistentHashPartitioner",
+    "ParamShard",
+    "Partitioner",
+    "RangePartitioner",
+    "ShardConnection",
+    "ShardCrashed",
+    "ShardServer",
+    "StalenessClock",
+]

@@ -1,0 +1,384 @@
+"""ClusterClient — the worker side of the multi-shard runtime.
+
+Implements the :class:`~..core.api.ParameterServerClient` ABC against
+real shard sockets, plus the batch surface the compiled path uses.
+Three bandwidth levers from the reference's sender stack
+(SURVEY.md §2 #6), rebuilt for the wire:
+
+  * **request coalescing** — duplicate ids inside one microbatch
+    collapse to one pull per id (:func:`~..ops.dedup.coalesce_ids`);
+    a Zipf-hot item appearing 300× per batch costs one line, and the
+    answer scatters back to every lane via the inverse map;
+  * **delta aggregation** — duplicate-id push deltas are summed before
+    the bytes move (:func:`~..ops.dedup.aggregate_deltas`) — exactly
+    the store's intra-batch combine semantics, applied at the sender;
+  * **pipelined pulls with an in-flight window** — each shard
+    connection carries up to ``window`` outstanding request frames
+    (responses come back in order, the line-protocol contract), so the
+    client overlaps shard round trips instead of paying RTT per chunk.
+    The live window usage is the ``inflight_pulls`` gauge
+    (``component=cluster``) — the same observability the event API's
+    pull limiter got (:func:`~..core.api.add_pull_limiter`).
+
+Shards are contacted concurrently (one lightweight thread per shard
+per batch call): a pull's wall time is the SLOWEST shard's round trip,
+not the sum — which is what makes the 1→2→4-shard scaling benchmark
+(``benchmarks/cluster_scaling.py``) a real scaling measurement.
+
+Pull RTT lands in a ``cluster_pull_rtt_seconds`` histogram per client
+(p99 is the benchmark's tail-latency column).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.api import ParameterServerClient
+from ..ops.dedup import aggregate_deltas, coalesce_ids
+from .partition import Partitioner
+from .shard import format_rows, parse_rows
+
+
+class ShardConnection:
+    """One pipelined line-protocol connection to one shard.
+
+    ``request_many`` keeps up to ``window`` frames outstanding; the
+    shard answers in order, so responses re-associate positionally.
+    Not thread-safe — each worker owns its connections (the driver
+    builds one client per worker).
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        window: int = 8,
+        timeout: float = 30.0,
+        connect_timeout: float = 10.0,
+    ):
+        if window < 1:
+            raise ValueError(f"window={window}: must be >= 1")
+        self.host, self.port = host, port
+        self.window = int(window)
+        self._sock = socket.create_connection(
+            (host, port), timeout=connect_timeout
+        )
+        self._sock.settimeout(timeout)
+        try:
+            # pipelined request frames must leave NOW, not after Nagle
+            # pairs them with a delayed ACK (~40 ms/frame otherwise)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self._rfile = self._sock.makefile("rb")
+        self.inflight = 0
+        self.requests_sent = 0
+
+    def request_many(self, lines: Sequence[str]) -> List[str]:
+        """Pipelined request/response: send up to ``window`` frames
+        ahead of the reads, return one response line per request."""
+        out: List[str] = []
+        pending = 0
+        it = iter(lines)
+        sent = 0
+        total = len(lines)
+        while sent < total or pending:
+            while pending < self.window and sent < total:
+                line = next(it)
+                self._sock.sendall(line.encode("utf-8") + b"\n")
+                pending += 1
+                sent += 1
+                self.inflight = pending
+                self.requests_sent += 1
+            raw = self._rfile.readline()
+            if not raw:
+                raise ConnectionError(
+                    f"shard {self.host}:{self.port} closed mid-pipeline "
+                    f"({len(out)}/{total} responses)"
+                )
+            out.append(raw.decode("utf-8", "replace").rstrip("\n"))
+            pending -= 1
+            self.inflight = pending
+        return out
+
+    def request(self, line: str) -> str:
+        return self.request_many([line])[0]
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _check_ok(resp: str, what: str) -> str:
+    if not resp.startswith("ok"):
+        raise RuntimeError(f"{what} failed: {resp}")
+    return resp
+
+
+class ClusterClient(ParameterServerClient):
+    """Worker-side handle over every shard.
+
+    Batch surface (the compiled path): :meth:`pull_batch` /
+    :meth:`push_batch` — coalesced, pipelined, shard-parallel.
+    Event surface (the ABC): :meth:`pull` buffers the id, :meth:`push`
+    buffers the delta; :meth:`drain` flushes both coalesced and
+    delivers pull answers to a callback — the combination-sender
+    semantics per worker.
+    """
+
+    def __init__(
+        self,
+        addresses: Sequence[Tuple[str, int]],
+        partitioner: Partitioner,
+        value_shape: Sequence[int] = (),
+        *,
+        window: int = 8,
+        chunk: int = 512,
+        timeout: float = 30.0,
+        wire_format: str = "b64",
+        registry=None,
+        worker: Optional[str] = None,
+    ):
+        if len(addresses) != partitioner.num_shards:
+            raise ValueError(
+                f"{len(addresses)} shard addresses for a "
+                f"{partitioner.num_shards}-shard partitioner"
+            )
+        if chunk < 1:
+            raise ValueError(f"chunk={chunk}: must be >= 1")
+        if wire_format not in ("text", "b64"):
+            raise ValueError(f"wire_format={wire_format!r}: 'text' | 'b64'")
+        self.partitioner = partitioner
+        self.value_shape = tuple(int(s) for s in value_shape)
+        self.chunk = int(chunk)
+        # b64 (default): exact fp32 bytes, ~100x cheaper than per-float
+        # text (shard.py module docstring); "text" for debuggability
+        self.wire_format = wire_format
+        self._conns = [
+            ShardConnection(h, p, window=window, timeout=timeout)
+            for h, p in addresses
+        ]
+        self.outputs: List[object] = []
+        self._pending_pulls: List[int] = []
+        self._pending_pushes: List[Tuple[int, np.ndarray]] = []
+        self.pulls_coalesced = 0  # duplicate lanes saved from the wire
+        self.pushes_coalesced = 0
+        # unified plane (component=cluster): the pull RTT histogram and
+        # the live in-flight window gauge
+        if registry is not False:
+            from ..telemetry.registry import get_registry
+
+            reg = registry if registry is not None else get_registry()
+            labels = {"worker": worker} if worker is not None else {}
+            self._h_rtt = reg.histogram(
+                "cluster_pull_rtt_seconds", component="cluster", **labels
+            )
+            reg.gauge(
+                "inflight_pulls", component="cluster", fn=self.inflight,
+                **labels,
+            )
+        else:
+            self._h_rtt = None
+
+    # -- observability ------------------------------------------------------
+    def inflight(self) -> int:
+        """Outstanding pull/push frames across every shard connection —
+        the live pipelining depth (<= window × shards)."""
+        return sum(c.inflight for c in self._conns)
+
+    # -- the batch surface --------------------------------------------------
+    def pull_batch(
+        self, ids, mask=None, *, dtype=np.float32
+    ) -> np.ndarray:
+        """Pull values for ``ids`` (any shape); returns
+        ``ids.shape + value_shape`` float32.  Duplicate ids cost one
+        wire request; per-shard traffic runs concurrently."""
+        ids_arr = np.asarray(ids)
+        unique, inverse = coalesce_ids(ids_arr, mask)
+        self.pulls_coalesced += int(ids_arr.size - unique.size)
+        by_shard = self._split(unique)
+        results: Dict[int, np.ndarray] = {}
+        self._for_each_shard(
+            by_shard,
+            lambda s, sids: results.__setitem__(s, self._pull_shard(s, sids)),
+        )
+        width = int(np.prod(self.value_shape)) if self.value_shape else 1
+        flat = np.empty((unique.size, width), dtype)
+        for s, sids in by_shard.items():
+            pos = np.searchsorted(unique, sids)
+            flat[pos] = results[s].reshape(len(sids), width)
+        out = flat.reshape(unique.shape + self.value_shape)
+        return out[inverse]
+
+    def push_batch(self, ids, deltas, mask=None) -> int:
+        """Aggregate duplicate-id deltas, push each shard's share (in
+        parallel, pipelined); returns unique ids pushed."""
+        ids_arr = np.asarray(ids)
+        unique, summed = aggregate_deltas(ids_arr, np.asarray(deltas), mask)
+        if unique.size == 0:
+            return 0
+        self.pushes_coalesced += int(
+            (ids_arr.size if mask is None else int(np.asarray(mask).sum()))
+            - unique.size
+        )
+        by_shard = self._split(unique)
+        self._for_each_shard(
+            by_shard,
+            lambda s, sids: self._push_shard(
+                s, sids, summed[np.searchsorted(unique, sids)]
+            ),
+        )
+        return int(unique.size)
+
+    def flush(self) -> List[str]:
+        """FLUSH every shard (WAL fsync + ack) — the explicit durability
+        barrier a bound-0 round ends with when durability matters."""
+        return [
+            _check_ok(c.request("flush"), f"flush shard {s}")
+            for s, c in enumerate(self._conns)
+        ]
+
+    def shard_stats(self) -> List[dict]:
+        import json
+
+        out = []
+        for s, c in enumerate(self._conns):
+            resp = _check_ok(c.request("stats"), f"stats shard {s}")
+            out.append(json.loads(resp[3:]))
+        return out
+
+    # -- the event-API surface (ParameterServerClient) ----------------------
+    def pull(self, param_id: int) -> None:
+        """Buffer a pull; answers arrive at the next :meth:`drain` —
+        the asynchronous contract of the ABC, with the microbatch as
+        the combination buffer."""
+        self._pending_pulls.append(int(param_id))
+
+    def push(self, param_id: int, delta) -> None:
+        self._pending_pushes.append((int(param_id), np.asarray(delta)))
+
+    def output(self, w_out) -> None:
+        self.outputs.append(w_out)
+
+    def drain(self, on_pull_recv=None) -> int:
+        """Flush buffered pushes (aggregated) and answer buffered pulls
+        (coalesced); ``on_pull_recv(param_id, value, client)`` is
+        invoked once per buffered pull, in buffering order.  Returns
+        the number of answers delivered."""
+        if self._pending_pushes:
+            ids = np.asarray([i for i, _ in self._pending_pushes], np.int64)
+            deltas = np.stack([d for _, d in self._pending_pushes])
+            self._pending_pushes = []
+            self.push_batch(ids, deltas)
+        n = 0
+        if self._pending_pulls:
+            ids = np.asarray(self._pending_pulls, np.int64)
+            self._pending_pulls = []
+            values = self.pull_batch(ids)
+            for i, pid in enumerate(ids):
+                if on_pull_recv is not None:
+                    on_pull_recv(int(pid), values[i], self)
+                n += 1
+        return n
+
+    def close(self) -> None:
+        for c in self._conns:
+            c.close()
+
+    # -- internals ----------------------------------------------------------
+    def _split(self, unique_ids: np.ndarray) -> Dict[int, np.ndarray]:
+        shards = self.partitioner.shard_of(unique_ids)
+        return {
+            int(s): unique_ids[shards == s] for s in np.unique(shards)
+        }
+
+    def _for_each_shard(self, by_shard: Dict[int, np.ndarray], fn) -> None:
+        """Run ``fn(shard, ids)`` for every shard concurrently (one
+        thread per contacted shard; errors propagate to the caller)."""
+        items = list(by_shard.items())
+        if len(items) == 1:
+            fn(*items[0])
+            return
+        errors: List[BaseException] = []
+
+        def run(s, sids):
+            try:
+                fn(s, sids)
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(s, sids), daemon=True)
+            for s, sids in items
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    def _pull_shard(self, shard: int, ids: np.ndarray) -> np.ndarray:
+        conn = self._conns[shard]
+        chunks = [
+            ids[i: i + self.chunk] for i in range(0, len(ids), self.chunk)
+        ]
+        lines = [
+            "pull " + ",".join(str(int(i)) for i in c)
+            + (" b64" if self.wire_format == "b64" else "")
+            for c in chunks
+        ]
+        t0 = time.perf_counter()
+        resps = conn.request_many(lines)
+        if self._h_rtt is not None:
+            # one observation per chunk frame: the pipelined per-frame
+            # turnaround, amortised (total wall / frames)
+            per = (time.perf_counter() - t0) / max(1, len(lines))
+            for _ in lines:
+                self._h_rtt.observe(per)
+        rows = []
+        for resp, c in zip(resps, chunks):
+            _check_ok(resp, f"pull shard {shard}")
+            _, _, body = resp.partition(" ")
+            _, _, body = body.partition(" ")  # strip "n=<k>"
+            vals = parse_rows(body, self.value_shape)
+            if len(vals) != len(c):
+                raise RuntimeError(
+                    f"shard {shard} answered {len(vals)} rows for "
+                    f"{len(c)} ids"
+                )
+            rows.append(vals)
+        return np.concatenate(rows) if rows else np.empty(
+            (0,) + self.value_shape, np.float32
+        )
+
+    def _push_shard(
+        self, shard: int, ids: np.ndarray, deltas: np.ndarray
+    ) -> None:
+        conn = self._conns[shard]
+        lines = []
+        for i in range(0, len(ids), self.chunk):
+            c_ids = ids[i: i + self.chunk]
+            c_del = deltas[i: i + self.chunk]
+            lines.append(
+                "push "
+                + ",".join(str(int(x)) for x in c_ids)
+                + " "
+                + format_rows(c_del, self.wire_format)
+            )
+        for resp in conn.request_many(lines):
+            _check_ok(resp, f"push shard {shard}")
+
+
+__all__ = ["ClusterClient", "ShardConnection"]

@@ -1,0 +1,384 @@
+"""ClusterDriver — N parameter-server shards × M workers, one job.
+
+The multi-process shape of the source paper, finally runnable: shard
+processes own key-partitioned state (:class:`~.shard.ParamShard` behind
+:class:`~.shard.ShardServer` TCP front ends), workers exchange
+asynchronous pull/push traffic against them
+(:class:`~.client.ClusterClient`), and a bounded-staleness clock
+(:class:`~.clock.StalenessClock`) dials the consistency between BSP
+(``staleness_bound=0``), SSP (``k``) and fully async (``None``).
+
+Execution model (per round ``t``, per worker ``w``):
+
+  1. ``clock.wait_for_turn(w)`` — the SSP gate;
+  2. mask the global microbatch down to the rows ``w`` owns (rows are
+     routed by a stable hash of the ``worker_key`` column, so an
+     entity's updates always land on one worker — the reference's
+     keyBy-user worker partitioning);
+  3. pull the batch's param rows from the shards (coalesced,
+     pipelined, shard-parallel);
+  4. run the SAME jitted :meth:`~..core.batched.BatchedWorkerLogic.step`
+     the single-process driver compiles — worker state (e.g. MF user
+     factors) stays worker-local;
+  5. push the masked deltas back (aggregated per id);
+  6. ``clock.tick(w)``.
+
+With ``staleness_bound=0`` an extra intra-round barrier separates the
+pull and push phases, so every round-``t`` read sees exactly the
+post-round-``t−1`` table — which is why a bound-0 cluster run lands
+allclose-equal (fp32) to :class:`~..training.driver.StreamingDriver`
+on the same stream (tests/test_cluster.py BSP parity).  With a bound
+``k`` the fast workers run up to ``k`` rounds ahead and the staleness
+gauge (``cluster_staleness_steps``) shows the spread live on
+``/metrics``.
+
+Everything is thread-backed and sleep-free on the happy path — the
+whole topology runs inside one pytest-tier process — but every byte
+still crosses a real TCP socket, so the wire protocol, coalescing and
+pipelining are exercised for real.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.batched import BatchedWorkerLogic
+from ..ops.hashing import fmix32_np
+from .client import ClusterClient
+from .clock import StalenessClock
+from .partition import ConsistentHashPartitioner, Partitioner, RangePartitioner
+from .shard import ParamShard, ShardServer
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """Topology + consistency knobs for a cluster run."""
+
+    num_shards: int = 2
+    num_workers: int = 1
+    # 0 = BSP (parity with the single-process driver), k > 0 = SSP,
+    # None = fully asynchronous (never block)
+    staleness_bound: Optional[int] = 0
+    partition: str = "range"  # "range" | "hash" (see cluster/partition.py)
+    # which batch column routes rows to workers (entity affinity: one
+    # entity's updates always land on one worker)
+    worker_key: str = "user"
+    # client knobs: pipelining window (outstanding frames per shard
+    # connection), ids per frame, payload encoding (shard.py: "b64"
+    # exact+fast, "text" exact+debuggable)
+    window: int = 8
+    chunk: int = 512
+    wire_format: str = "b64"
+    # per-shard WALs under <wal_dir>/shard-<i>; None = no durability
+    wal_dir: Optional[str] = None
+    supervised: bool = True  # ShardServer restart supervision
+    host: str = "127.0.0.1"
+    request_timeout: float = 30.0
+
+
+@dataclasses.dataclass
+class ClusterResult:
+    """What a cluster run hands back (the TransformResult analogue)."""
+
+    values: np.ndarray  # final global table, assembled from the shards
+    worker_outputs: List[Any]
+    worker_states: List[Any]
+    rounds: int
+    events: int
+    wall_s: float
+    clock: Dict[str, Any]
+    shard_stats: List[dict]
+
+    @property
+    def updates_per_sec(self) -> float:
+        return self.events / self.wall_s if self.wall_s > 0 else 0.0
+
+
+class ClusterDriver:
+    """Own the topology: build it, run a job through it, tear it down.
+
+    ``logic`` is any :class:`~..core.batched.BatchedWorkerLogic` —
+    the same object the single-process :class:`StreamingDriver` runs;
+    ``capacity``/``value_shape``/``init_fn`` describe the global table
+    exactly as :meth:`ShardedParamStore.create` would (deterministic
+    per-id init is what makes shard slices equal the global table's
+    rows).
+    """
+
+    def __init__(
+        self,
+        logic: BatchedWorkerLogic,
+        *,
+        capacity: int,
+        value_shape: Sequence[int] = (),
+        init_fn=None,
+        config: Optional[ClusterConfig] = None,
+        partitioner: Optional[Partitioner] = None,
+        rng=None,
+        registry=None,
+    ):
+        self.logic = logic
+        self.capacity = int(capacity)
+        self.value_shape = tuple(int(s) for s in value_shape)
+        self.config = config if config is not None else ClusterConfig()
+        cfg = self.config
+        if partitioner is not None:
+            self.partitioner = partitioner
+        elif cfg.partition == "range":
+            self.partitioner = RangePartitioner(capacity, cfg.num_shards)
+        elif cfg.partition == "hash":
+            self.partitioner = ConsistentHashPartitioner(
+                capacity, cfg.num_shards
+            )
+        else:
+            raise ValueError(
+                f"partition={cfg.partition!r}: 'range' | 'hash'"
+            )
+        self._init_fn = init_fn
+        self._rng = rng
+        if registry is not False:
+            from ..telemetry.registry import get_registry
+
+            self.registry = registry if registry is not None else get_registry()
+        else:
+            self.registry = None
+        self.shards: List[ParamShard] = []
+        self.servers: List[ShardServer] = []
+        self.clock: Optional[StalenessClock] = None
+        self._clients: List[ClusterClient] = []
+        self._started = False
+        self._step_fn = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ClusterDriver":
+        if self._started:
+            return self
+        cfg = self.config
+        for s in range(cfg.num_shards):
+            wal_dir = (
+                None if cfg.wal_dir is None
+                else f"{cfg.wal_dir}/shard-{s}"
+            )
+            shard = ParamShard(
+                s, self.partitioner, self.value_shape,
+                init_fn=self._init_fn, wal_dir=wal_dir,
+                registry=self.registry if self.registry is not None else False,
+            )
+            server = ShardServer(
+                shard, cfg.host, 0, supervised=cfg.supervised
+            ).start()
+            self.shards.append(shard)
+            self.servers.append(server)
+        self._clients = [
+            self._make_client(worker=str(w))
+            for w in range(cfg.num_workers)
+        ]
+        self.clock = StalenessClock(cfg.num_workers, cfg.staleness_bound)
+        if self.registry is not None:
+            self.registry.gauge(
+                "cluster_staleness_steps", component="cluster",
+                fn=lambda: (
+                    self.clock.staleness() if self.clock is not None else None
+                ),
+            )
+        self._started = True
+        return self
+
+    def _make_client(self, worker: Optional[str] = None) -> ClusterClient:
+        cfg = self.config
+        return ClusterClient(
+            [(srv.host, srv.port) for srv in self.servers],
+            self.partitioner,
+            self.value_shape,
+            window=cfg.window,
+            chunk=cfg.chunk,
+            timeout=cfg.request_timeout,
+            wire_format=cfg.wire_format,
+            registry=self.registry if self.registry is not None else False,
+            worker=worker,
+        )
+
+    def stop(self) -> None:
+        for c in self._clients:
+            c.close()
+        self._clients = []
+        for srv in self.servers:
+            srv.stop()
+        for shard in self.shards:
+            shard.close()
+        self.servers = []
+        self.shards = []
+        self._started = False
+
+    def __enter__(self) -> "ClusterDriver":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the job ------------------------------------------------------------
+    def _worker_mask(self, batch: dict, worker: int) -> np.ndarray:
+        cfg = self.config
+        base = np.asarray(
+            batch.get("mask", np.ones(self._batch_len(batch), bool))
+        ).astype(bool)
+        if cfg.num_workers == 1:
+            return base
+        if cfg.worker_key not in batch:
+            raise ValueError(
+                f"num_workers={cfg.num_workers} needs batch column "
+                f"{cfg.worker_key!r} to route rows (set "
+                f"ClusterConfig.worker_key)"
+            )
+        keys = np.asarray(batch[cfg.worker_key], np.int64)
+        owner = fmix32_np(keys) % np.uint32(cfg.num_workers)
+        return base & (owner == np.uint32(worker))
+
+    @staticmethod
+    def _batch_len(batch: dict) -> int:
+        return len(next(iter(batch.values())))
+
+    def run(
+        self,
+        batches,
+        *,
+        collect_outputs: bool = False,
+        round_hook: Optional[Callable[[int, int], None]] = None,
+        timeout: float = 300.0,
+    ) -> ClusterResult:
+        """Train over ``batches`` (a finite iterable of microbatch
+        dicts); every worker walks the full sequence with its ownership
+        mask applied.  ``round_hook(worker, round)`` fires at each round
+        start on the worker's thread — the straggler-injection point
+        the SSP tests use.  Returns the assembled final table."""
+        import jax
+
+        if not self._started:
+            self.start()
+        cfg = self.config
+        batches = list(batches)
+        if self._step_fn is None:
+            self._step_fn = jax.jit(self.logic.step)
+        rng = (
+            self._rng if self._rng is not None else jax.random.PRNGKey(0)
+        )
+        # fresh clock per run: the previous run's workers deactivated
+        # themselves at stream end (frozen counters must not gate a new
+        # job); the staleness gauge reads self.clock so it follows
+        clock = self.clock = StalenessClock(
+            cfg.num_workers, cfg.staleness_bound
+        )
+        # bound-0 intra-round barrier: reads of round t must not see
+        # round-t writes (see module docstring)
+        pull_barrier = (
+            threading.Barrier(cfg.num_workers)
+            if cfg.staleness_bound == 0 and cfg.num_workers > 1
+            else None
+        )
+        errors: List[BaseException] = []
+        states: List[Any] = [None] * cfg.num_workers
+        outputs: List[List[Any]] = [[] for _ in range(cfg.num_workers)]
+        events = [0] * cfg.num_workers
+        c_rounds = (
+            self.registry.counter(
+                "cluster_worker_rounds_total", component="cluster"
+            )
+            if self.registry is not None
+            else None
+        )
+
+        def worker_loop(w: int) -> None:
+            import jax.numpy as jnp
+
+            client = self._clients[w]
+            state = self.logic.init_state(rng)
+            try:
+                for t, batch in enumerate(batches):
+                    if errors:
+                        break
+                    if round_hook is not None:
+                        round_hook(w, t)
+                    if not clock.wait_for_turn(w, timeout=timeout):
+                        raise TimeoutError(
+                            f"worker {w} starved at round {t} "
+                            f"(bound={cfg.staleness_bound})"
+                        )
+                    wb = dict(batch)
+                    wb["mask"] = self._worker_mask(batch, w)
+                    ids = np.asarray(self.logic.keys(wb))
+                    pulled = client.pull_batch(ids, mask=wb["mask"])
+                    if pull_barrier is not None:
+                        pull_barrier.wait(timeout=timeout)
+                    state, req, out = self._step_fn(
+                        state, wb, jnp.asarray(pulled)
+                    )
+                    req_mask = (
+                        None if req.mask is None else np.asarray(req.mask)
+                    )
+                    client.push_batch(
+                        np.asarray(req.ids), np.asarray(req.deltas),
+                        req_mask,
+                    )
+                    clock.tick(w)
+                    events[w] += int(wb["mask"].sum())
+                    if c_rounds is not None:
+                        c_rounds.inc()
+                    if collect_outputs:
+                        outputs[w].append(jax.tree.map(np.asarray, out))
+                states[w] = state
+            except BaseException as e:  # noqa: BLE001 — joined below
+                errors.append(e)
+                if pull_barrier is not None:
+                    pull_barrier.abort()
+            finally:
+                clock.deactivate(w)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(
+                target=worker_loop, args=(w,), name=f"cluster-worker-{w}",
+                daemon=True,
+            )
+            for w in range(cfg.num_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        wall = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return ClusterResult(
+            values=self.final_values(),
+            worker_outputs=(
+                [o for outs in outputs for o in outs]
+                if collect_outputs else []
+            ),
+            worker_states=states,
+            rounds=len(batches),
+            events=int(sum(events)),
+            wall_s=wall,
+            clock=clock.snapshot(),
+            shard_stats=[s.stats() for s in self.shards],
+        )
+
+    def final_values(self) -> np.ndarray:
+        """Assemble the global table from the shards (through the wire
+        — the dump is itself a protocol exercise), rows in global-id
+        order: the cluster analogue of
+        :meth:`~..core.store.ShardedParamStore.values`."""
+        client = self._clients[0] if self._clients else self._make_client()
+        try:
+            return client.pull_batch(
+                np.arange(self.capacity, dtype=np.int64)
+            )
+        finally:
+            if not self._clients:
+                client.close()
+
+
+__all__ = ["ClusterConfig", "ClusterDriver", "ClusterResult"]

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import abc
 import collections
-from typing import Callable, Generic, TypeVar
+from typing import Callable, Generic, Optional, TypeVar
 
 T = TypeVar("T")  # training-data record type
 P = TypeVar("P")  # parameter value type
@@ -178,11 +178,28 @@ class _PullLimiter:
             self.in_flight += 1
             client.pull(self.queue.popleft())
 
+    def inflight(self) -> int:
+        """Pulls issued but not yet answered — the pipelining depth the
+        limiter is currently using (<= ``limit``; queued requests are
+        NOT in flight).  Exposed so the telemetry plane can watch a
+        worker's pull pipeline live instead of inferring it."""
+        return self.in_flight
+
+    def queued(self) -> int:
+        """Pulls waiting for a window slot (the backpressure signal)."""
+        return len(self.queue)
+
 
 class _PullLimitedWorker(WorkerLogic[T, P, WOut]):
     def __init__(self, inner: WorkerLogic[T, P, WOut], limit: int):
         self._inner = inner
         self._limiter = _PullLimiter(limit)
+
+    @property
+    def limiter(self) -> _PullLimiter:
+        """The wrapped limiter (its ``inflight()``/``queued()`` are the
+        observability surface ``add_pull_limiter`` registers as gauges)."""
+        return self._limiter
 
     def on_recv(self, data, ps):
         self._inner.on_recv(data, _PullLimitedClient(ps, self._limiter))
@@ -196,12 +213,40 @@ class _PullLimitedWorker(WorkerLogic[T, P, WOut]):
 
 
 def add_pull_limiter(
-    worker_logic: WorkerLogic[T, P, WOut], limit: int
+    worker_logic: WorkerLogic[T, P, WOut],
+    limit: int,
+    *,
+    registry=None,
+    worker: Optional[str] = None,
 ) -> WorkerLogic[T, P, WOut]:
     """Bound the number of in-flight pulls per worker — the reference's
     ``WorkerLogic.addPullLimiter`` (SURVEY.md §2 #2).  Excess pulls queue on
-    the worker and are issued as answers come back."""
-    return _PullLimitedWorker(worker_logic, limit)
+    the worker and are issued as answers come back.
+
+    The limiter's window usage is observable live: ``inflight_pulls``
+    and ``queued_pulls`` probe gauges (``component=train``, plus a
+    ``worker=`` label when given) register on ``registry`` — default the
+    process-wide one — so a pipeline stuck at its window (inflight
+    pinned at ``limit``, queue growing) shows on ``/metrics`` instead of
+    being invisible inside the event loop.  ``registry=False`` opts out
+    (pure-unit tests)."""
+    wrapped = _PullLimitedWorker(worker_logic, limit)
+    if registry is not False:
+        # lazy import: core/ must not import telemetry/ at module load
+        # (telemetry is a leaf plane, core is the trunk)
+        from ..telemetry.registry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        labels = {"worker": worker} if worker is not None else {}
+        reg.gauge(
+            "inflight_pulls", component="train",
+            fn=wrapped.limiter.inflight, **labels,
+        )
+        reg.gauge(
+            "queued_pulls", component="train",
+            fn=wrapped.limiter.queued, **labels,
+        )
+    return wrapped
 
 
 __all__ = [

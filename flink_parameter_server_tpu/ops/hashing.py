@@ -35,6 +35,24 @@ def _fmix32(h: Array) -> Array:
     return h
 
 
+def fmix32_np(h: np.ndarray) -> np.ndarray:
+    """Host-side (numpy) mirror of :func:`_fmix32` — same constants,
+    same avalanche, so routing decisions taken on the HOST (the cluster
+    partitioner picking a shard before a network send,
+    ``cluster/partition.py``) agree bit-for-bit with any device-side
+    use of this family.  Input is coerced to uint32; wraparound is the
+    hash, so the overflow warnings numpy would raise are suppressed
+    locally."""
+    with np.errstate(over="ignore"):
+        h = np.asarray(h).astype(np.uint32)
+        h ^= h >> np.uint32(16)
+        h = (h * _MIX1).astype(np.uint32)
+        h ^= h >> np.uint32(13)
+        h = (h * _MIX2).astype(np.uint32)
+        h ^= h >> np.uint32(16)
+    return h
+
+
 def hash_params(num_hashes: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
     """Draw per-hash (a, b) uint32 constants (a odd), deterministic in
     ``seed``."""
@@ -90,4 +108,11 @@ def permute_ids(ids: Array, capacity: int, seed: int = 0x5BD1) -> Array:
     return (h & jnp.uint32(capacity - 1)).astype(jnp.int32)
 
 
-__all__ = ["hash_params", "bucket_hash", "sign_hash", "pair_key", "permute_ids"]
+__all__ = [
+    "fmix32_np",
+    "hash_params",
+    "bucket_hash",
+    "sign_hash",
+    "pair_key",
+    "permute_ids",
+]

@@ -38,6 +38,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.store import ShardedParamStore, StoreSpec
+from ..utils.net import LineServer
 from .batcher import PendingRequest, QueueFull, RequestBatcher, pow2_bucket
 from .engine import LookupResult, NoSnapshotError, QueryEngine, TopKResult
 from .metrics import ServingMetrics
@@ -399,12 +400,14 @@ def parse_response(line: str) -> dict:
     return out
 
 
-class ServingServer:
+class ServingServer(LineServer):
     """Line-protocol TCP front end over a :class:`ServingService`.
 
     ``port=0`` binds an ephemeral port (read it back from ``.port``).
-    One handler thread per connection; requests on a connection are
-    answered in order.
+    The socket plumbing (accept loop, per-connection threads, the line
+    reassembly + overflow guard, shutdown) lives in
+    :class:`~..utils.net.LineServer`; this class is the protocol —
+    :meth:`respond` answers one request line with one response line.
     """
 
     def __init__(
@@ -416,86 +419,19 @@ class ServingServer:
         request_timeout: float = 30.0,
         max_line_bytes: int = 1 << 20,
     ):
+        super().__init__(
+            host, port, name="serving", max_line_bytes=max_line_bytes
+        )
         self.service = service
         self.request_timeout = float(request_timeout)
-        self.max_line_bytes = int(max_line_bytes)
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(16)
-        self.host, self.port = self._sock.getsockname()[:2]
-        self._accept_thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
-        self._conns: List[socket.socket] = []
-        self._conns_lock = threading.Lock()
 
     def start(self) -> "ServingServer":
         self.service.start()
-        if self._accept_thread is None or not self._accept_thread.is_alive():
-            self._stop.clear()
-            self._accept_thread = threading.Thread(
-                target=self._accept_loop, name="serving-accept", daemon=True
-            )
-            self._accept_thread.start()
+        super().start()
         return self
 
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        with self._conns_lock:
-            for c in self._conns:
-                try:
-                    c.close()
-                except OSError:
-                    pass
-            self._conns.clear()
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
-            self._accept_thread = None
-
-    # -- internals ---------------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _addr = self._sock.accept()
-            except OSError:
-                return  # listener closed
-            with self._conns_lock:
-                self._conns.append(conn)
-            threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
-            ).start()
-
-    def _handle(self, conn: socket.socket) -> None:
-        buf = b""
-        try:
-            while not self._stop.is_set():
-                chunk = conn.recv(1 << 16)
-                if not chunk:
-                    return
-                buf += chunk
-                if len(buf) > self.max_line_bytes and b"\n" not in buf:
-                    conn.sendall(b"err bad-request: line too long\n")
-                    return
-                *lines, buf = buf.split(b"\n")
-                for raw in lines:
-                    line = raw.decode("utf-8", "replace").strip()
-                    if not line:
-                        continue
-                    resp = self._respond(line)
-                    conn.sendall(resp.encode("utf-8") + b"\n")
-        except OSError:
-            return
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
-
-    def _respond(self, line: str) -> str:
+    # -- the protocol ------------------------------------------------------
+    def respond(self, line: str) -> str:
         try:
             fut = self._admit(line)
         except QueueFull:

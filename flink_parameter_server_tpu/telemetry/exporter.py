@@ -18,9 +18,9 @@ from __future__ import annotations
 
 import json
 import socket
-import threading
 from typing import List, Optional
 
+from ..utils.net import LineServer
 from .registry import Histogram, MetricsRegistry, get_registry
 
 # metric names go out namespaced; label values get minimal escaping
@@ -104,7 +104,7 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
-class TelemetryServer:
+class TelemetryServer(LineServer):
     """``GET /metrics`` (Prometheus text) + ``GET /healthz`` (JSON) over
     TCP, serving LIVE registry values while training runs.
 
@@ -113,6 +113,11 @@ class TelemetryServer:
     attached, ``/healthz`` reports per-component heartbeat ages and
     degrades ``status`` to ``"stalled"`` past ``stall_after_s`` — the
     watchdog's view, scrapeable before the watchdog fires.
+
+    Socket plumbing comes from :class:`~..utils.net.LineServer`; the
+    scrape endpoint overrides :meth:`handle_connection` whole because
+    its protocol is one-shot (one answer, HTTP or bare, then close),
+    not line-per-request.
     """
 
     def __init__(
@@ -125,105 +130,58 @@ class TelemetryServer:
         stall_after_s: Optional[float] = None,
         max_request_bytes: int = 8192,
     ):
+        super().__init__(host, port, name="telemetry")
         self.registry = registry if registry is not None else get_registry()
         self.health = health
         self.stall_after_s = stall_after_s
         self.max_request_bytes = int(max_request_bytes)
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(16)
-        self.host, self.port = self._sock.getsockname()[:2]
-        self._accept_thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
 
-    # -- lifecycle ---------------------------------------------------------
     def start(self) -> "TelemetryServer":
-        if self._accept_thread is None or not self._accept_thread.is_alive():
-            self._stop.clear()
-            self._accept_thread = threading.Thread(
-                target=self._accept_loop, name="telemetry-accept",
-                daemon=True,
-            )
-            self._accept_thread.start()
+        super().start()
         return self
 
-    def stop(self) -> None:
-        self._stop.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
-        if self._accept_thread is not None:
-            self._accept_thread.join(timeout=5)
-            self._accept_thread = None
-
-    def __enter__(self) -> "TelemetryServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()
-
     # -- request handling --------------------------------------------------
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _addr = self._sock.accept()
-            except OSError:
-                return  # listener closed
-            threading.Thread(
-                target=self._handle, args=(conn,), daemon=True
-            ).start()
-
-    def _handle(self, conn: socket.socket) -> None:
-        try:
-            conn.settimeout(5.0)
-            buf = b""
-            # one request line is enough; drain headers best-effort so
-            # an HTTP client's request doesn't RST on early close
-            while b"\n" not in buf and len(buf) < self.max_request_bytes:
-                chunk = conn.recv(4096)
-                if not chunk:
-                    return
-                buf += chunk
-            first = buf.split(b"\n", 1)[0].decode(
-                "utf-8", "replace"
-            ).strip()
-            http = first.upper().startswith(("GET ", "HEAD "))
-            path = first.split()[1] if http and len(
-                first.split()
-            ) >= 2 else first
-            path = path.strip().lstrip("/").lower() or "metrics"
-            if path.startswith("metrics"):
-                body = prometheus_text(self.registry)
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-                status = "200 OK"
-            elif path.startswith("healthz"):
-                body = json.dumps(self._healthz()) + "\n"
-                ctype = "application/json"
-                status = "200 OK"
-            else:
-                body = f"unknown path {path!r} (metrics|healthz)\n"
-                ctype = "text/plain; charset=utf-8"
-                status = "404 Not Found"
-            payload = body.encode("utf-8")
-            if http:
-                head = (
-                    f"HTTP/1.0 {status}\r\n"
-                    f"Content-Type: {ctype}\r\n"
-                    f"Content-Length: {len(payload)}\r\n"
-                    f"Connection: close\r\n\r\n"
-                ).encode("ascii")
-                conn.sendall(head + payload)
-            else:
-                conn.sendall(payload)
-        except OSError:
-            return
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+    def handle_connection(self, conn: socket.socket) -> None:
+        conn.settimeout(5.0)
+        buf = b""
+        # one request line is enough; drain headers best-effort so
+        # an HTTP client's request doesn't RST on early close
+        while b"\n" not in buf and len(buf) < self.max_request_bytes:
+            chunk = conn.recv(4096)
+            if not chunk:
+                return
+            buf += chunk
+        first = buf.split(b"\n", 1)[0].decode(
+            "utf-8", "replace"
+        ).strip()
+        http = first.upper().startswith(("GET ", "HEAD "))
+        path = first.split()[1] if http and len(
+            first.split()
+        ) >= 2 else first
+        path = path.strip().lstrip("/").lower() or "metrics"
+        if path.startswith("metrics"):
+            body = prometheus_text(self.registry)
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+            status = "200 OK"
+        elif path.startswith("healthz"):
+            body = json.dumps(self._healthz()) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
+        else:
+            body = f"unknown path {path!r} (metrics|healthz)\n"
+            ctype = "text/plain; charset=utf-8"
+            status = "404 Not Found"
+        payload = body.encode("utf-8")
+        if http:
+            head = (
+                f"HTTP/1.0 {status}\r\n"
+                f"Content-Type: {ctype}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: close\r\n\r\n"
+            ).encode("ascii")
+            conn.sendall(head + payload)
+        else:
+            conn.sendall(payload)
 
     def _healthz(self) -> dict:
         out = {"status": "ok", "run_id": self.registry.run_id}

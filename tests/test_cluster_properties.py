@@ -1,0 +1,121 @@
+"""Property-based partitioner invariants (hypothesis).
+
+The key→shard maps are the cluster's routing ground truth — client and
+shard must agree on them forever, across arbitrary capacities and shard
+counts.  These properties pin the three invariants the satellite
+demands over the whole parameter space, not just the fixtures
+``tests/test_cluster.py`` spot-checks:
+
+  * **totality** — every id in [0, capacity) routes to a valid shard,
+    and shard-local round trips (``to_local``/``to_global``) are exact
+    bijections over each shard's owned set;
+  * **balance within tolerance** — range splits differ by at most one
+    ceil block; rendezvous-hash shares stay within a multinomial band;
+  * **growth stability** — adding a shard to the consistent-hash map
+    moves keys only ONTO the new shard, never between survivors.
+"""
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")  # optional dep: skip, not a collection error
+from hypothesis import given, settings, strategies as st
+
+from flink_parameter_server_tpu.cluster.partition import (
+    ConsistentHashPartitioner,
+    RangePartitioner,
+)
+
+pytestmark = pytest.mark.cluster
+
+caps = st.integers(min_value=1, max_value=2048)
+seeds = st.integers(min_value=0, max_value=2**31 - 1)
+
+
+@settings(max_examples=60, deadline=None)
+@given(capacity=caps, num_shards=st.integers(1, 16), data=st.data())
+def test_range_total_balanced_bijective(capacity, num_shards, data):
+    num_shards = min(num_shards, capacity)
+    p = RangePartitioner(capacity, num_shards)
+    ids = np.arange(capacity)
+    shards = p.shard_of(ids)
+    # total
+    assert ((shards >= 0) & (shards < num_shards)).all()
+    # balanced: ceil-block split — sizes differ by at most one block,
+    # and every non-terminal shard holds the full block
+    sizes = np.bincount(shards, minlength=num_shards)
+    assert sizes.sum() == capacity
+    assert sizes.max() <= p.rows_per_shard
+    # bijective per shard
+    s = data.draw(st.integers(0, num_shards - 1))
+    owned = p.owned_ids(s)
+    assert len(owned) == sizes[s]
+    if len(owned):
+        assert np.array_equal(p.to_global(s, p.to_local(s, owned)), owned)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    capacity=st.integers(min_value=64, max_value=2048),
+    num_shards=st.integers(1, 8),
+    seed=seeds,
+)
+def test_hash_total_and_balanced(capacity, num_shards, seed):
+    p = ConsistentHashPartitioner(capacity, num_shards, seed=seed)
+    ids = np.arange(capacity)
+    shards = p.shard_of(ids)
+    assert ((shards >= 0) & (shards < num_shards)).all()
+    sizes = np.bincount(shards, minlength=num_shards)
+    assert sizes.sum() == capacity
+    # multinomial tolerance: mean ± 5σ (σ = sqrt(n·p·(1−p))) — loose
+    # enough to never flake, tight enough to catch a broken mixer
+    mean = capacity / num_shards
+    sigma = np.sqrt(capacity * (1 / num_shards) * (1 - 1 / num_shards))
+    assert sizes.max() <= mean + 5 * sigma + 1
+    assert sizes.min() >= max(0.0, mean - 5 * sigma - 1)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    capacity=st.integers(min_value=16, max_value=2048),
+    num_shards=st.integers(1, 8),
+    added=st.integers(1, 4),
+    seed=seeds,
+)
+def test_hash_growth_moves_keys_only_to_new_shards(
+    capacity, num_shards, added, seed
+):
+    """THE consistent-hash property, over the whole space: for every
+    key, growth either keeps its shard or assigns one of the NEW
+    shards — unchanged shards keep exactly their surviving keys."""
+    p_small = ConsistentHashPartitioner(capacity, num_shards, seed=seed)
+    p_big = p_small.grown(num_shards + added)
+    ids = np.arange(capacity)
+    before = p_small.shard_of(ids)
+    after = p_big.shard_of(ids)
+    moved = before != after
+    assert (after[moved] >= num_shards).all()
+    # equivalently: each old shard's post-growth set is a subset of its
+    # pre-growth set
+    for s in range(num_shards):
+        assert set(ids[after == s]) <= set(ids[before == s])
+
+
+@settings(max_examples=40, deadline=None)
+@given(
+    capacity=st.integers(min_value=32, max_value=1024),
+    num_shards=st.integers(2, 6),
+    seed=seeds,
+    data=st.data(),
+)
+def test_hash_local_ids_are_dense_bijections(
+    capacity, num_shards, seed, data
+):
+    p = ConsistentHashPartitioner(capacity, num_shards, seed=seed)
+    s = data.draw(st.integers(0, num_shards - 1))
+    owned = p.owned_ids(s)
+    if not len(owned):
+        return  # a tiny capacity can starve a shard; nothing to check
+    local = p.to_local(s, owned)
+    # dense: exactly [0, len(owned)) in order
+    assert np.array_equal(local, np.arange(len(owned)))
+    assert np.array_equal(p.to_global(s, local), owned)

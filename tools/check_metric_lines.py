@@ -19,12 +19,44 @@ skipped; everything else must ``json.loads`` to a dict carrying ``ts``
 (number) and ``run_id`` (string).  ``--allow-missing-ids`` relaxes the
 ts/run_id requirement (pre-telemetry logs).  Exit 0 = clean, 1 = at
 least one malformed line (each is reported with file:line and reason).
+
+Registry samples (``"kind": "registry"``) additionally have every
+``component=`` label checked against the known component set — a
+typo'd component silently forks a dashboard's series, so it fails the
+lint instead.
 """
 from __future__ import annotations
 
 import json
 import sys
 from typing import Iterable, List, Tuple
+
+# every component label the repo's emitters stamp (docs/observability.md
+# instrument catalog + docs/cluster.md): new planes register here so
+# their lines lint instead of linting AROUND them.  serving_dispatch is
+# the HealthMonitor heartbeat component (resilience/health.py SERVING).
+KNOWN_COMPONENTS = frozenset(
+    {"train", "serving", "ingest", "recovery", "cluster",
+     "serving_dispatch"}
+)
+
+
+def _unknown_components(obj: dict) -> List[str]:
+    """Component label values outside KNOWN_COMPONENTS in a registry
+    sample (empty list = clean)."""
+    bad = []
+    metrics = obj.get("metrics")
+    if not isinstance(metrics, dict):
+        return bad
+    for series in metrics.values():
+        if not isinstance(series, list):
+            continue
+        for inst in series:
+            labels = inst.get("labels") if isinstance(inst, dict) else None
+            comp = labels.get("component") if isinstance(labels, dict) else None
+            if comp is not None and comp not in KNOWN_COMPONENTS:
+                bad.append(str(comp))
+    return bad
 
 
 def check_lines(
@@ -57,6 +89,16 @@ def check_lines(
                 continue
             if not isinstance(obj.get("run_id"), str):
                 bad.append((i, "missing/non-string 'run_id'", line))
+                continue
+        if obj.get("kind") == "registry":
+            unknown = _unknown_components(obj)
+            if unknown:
+                bad.append((
+                    i,
+                    f"unknown component label(s) {sorted(set(unknown))} "
+                    f"(known: {sorted(KNOWN_COMPONENTS)})",
+                    line,
+                ))
     return bad
 
 
