@@ -847,6 +847,64 @@ def _emit_cluster_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_elastic_metric(platform: str, fallback: bool) -> None:
+    """Sixth (opt-in) metric line: the elastic resize path.
+
+    FPS_BENCH_ELASTIC=1 runs the mid-training 1→2→4 scale-out
+    (benchmarks/elastic_scaling.py: live resharding over thread-backed
+    shards, migration stall percentiles, hedging win rate, the
+    exactly-once audit) and writes
+    ``results/<platform>/elastic_scaling.{md,json}`` — the artifact
+    docs/perf_status.md requires any live-resize claim to cite.
+    Default 0 (the run costs tens of seconds); failure degrades to a
+    value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_ELASTIC", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_ELASTIC={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "elastic scaling (mid-training 1→2→4 scale-out)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.elastic_scaling import run_elastic_bench
+
+        # the module defaults (rounds=256, batch=2048, items=8192):
+        # shorter streams end before the second resize lands, starving
+        # the post-resize phase — the same configuration as the
+        # committed results/<platform>/elastic_scaling.json artifact
+        r = run_elastic_bench()
+        print(json.dumps({
+            "metric": metric,
+            "value": r["updates_per_sec_after"],
+            "unit": "updates/sec (post-resize)",
+            "extra": {
+                "updates_per_sec_before": r["updates_per_sec_before"],
+                "updates_per_sec_during": r["updates_per_sec_during"],
+                "updates_per_sec_after": r["updates_per_sec_after"],
+                "migration_stall_p50_ms": r["migration_stall_p50_ms"],
+                "migration_stall_p99_ms": r["migration_stall_p99_ms"],
+                "rows_migrated": r["rows_migrated"],
+                "hedged_pulls": r["hedged_pulls"],
+                "hedges_won": r["hedges_won"],
+                "hedge_win_rate": r["hedge_win_rate"],
+                "final_epoch": r["final_epoch"],
+                "exactly_once": r["exactly_once"],
+                "num_workers": r["num_workers"],
+                "batch": r["batch"],
+                "rounds": r["rounds"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "updates/sec (post-resize)",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -873,6 +931,7 @@ def main():
             _emit_recovery_metric(platform, fallback)
             _emit_telemetry_summary(platform, fallback)
             _emit_cluster_metric(platform, fallback)
+            _emit_elastic_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -926,6 +985,7 @@ def main():
     _emit_recovery_metric(platform, fallback)
     _emit_telemetry_summary(platform, fallback)
     _emit_cluster_metric(platform, fallback)
+    _emit_elastic_metric(platform, fallback)
 
 
 if __name__ == "__main__":

@@ -18,7 +18,13 @@ from .partition import (
     Partitioner,
     RangePartitioner,
 )
-from .shard import ParamShard, ShardCrashed, ShardServer
+from .shard import (
+    FrozenKeys,
+    ParamShard,
+    ShardCrashed,
+    ShardServer,
+    StaleEpoch,
+)
 
 __all__ = [
     "ClusterClient",
@@ -26,11 +32,13 @@ __all__ = [
     "ClusterDriver",
     "ClusterResult",
     "ConsistentHashPartitioner",
+    "FrozenKeys",
     "ParamShard",
     "Partitioner",
     "RangePartitioner",
     "ShardConnection",
     "ShardCrashed",
     "ShardServer",
+    "StaleEpoch",
     "StalenessClock",
 ]

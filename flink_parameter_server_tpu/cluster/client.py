@@ -27,9 +27,36 @@ not the sum — which is what makes the 1→2→4-shard scaling benchmark
 
 Pull RTT lands in a ``cluster_pull_rtt_seconds`` histogram per client
 (p99 is the benchmark's tail-latency column).
+
+Elastic routing (docs/elastic.md): handed a ``membership`` view
+(:class:`~..elastic.membership.MembershipService`), the client derives
+its partitioner + shard addresses from the CURRENT epoch, tags every
+pull/push frame with ``e=<epoch>``, and turns shard rejections into
+retries instead of errors:
+
+  * ``err stale-epoch`` — the map flipped under the frame: refresh the
+    membership view (counted in ``elastic_epoch_refreshes_total``),
+    re-route the frame's ids under the new map, replay;
+  * ``err frozen`` — the frame touches a key range mid-migration:
+    back off a few ms and replay (the flip that re-homes the range is
+    imminent);
+  * connection errors — a shard died or was replaced: drop the cached
+    connection, refresh (the controller publishes the replacement's
+    address under a new epoch), replay.  Pushes carry a per-batch
+    ``pid`` token so a replay of a frame whose ack was lost is
+    deduplicated shard-side — latency, never a double-apply.
+
+A client without ``membership`` behaves exactly as before: static
+addresses, no epoch tags, rejections raise.
+
+``hedge=`` accepts a :class:`~..elastic.hedging.Hedger`: pull frames
+race a budgeted backup connection against a slow shard — first answer
+wins (pulls are idempotent; pushes are never hedged).
 """
 from __future__ import annotations
 
+import itertools
+import os
 import socket
 import threading
 import time
@@ -111,6 +138,14 @@ class ShardConnection:
 
     def close(self) -> None:
         try:
+            # a reader blocked in readline() holds the buffer lock;
+            # rfile.close() would wait on it — shutdown() first makes
+            # the reader return EOF and release it (the hedging path
+            # closes connections whose racer thread is still draining)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._rfile.close()
         except OSError:
             pass
@@ -126,6 +161,25 @@ def _check_ok(resp: str, what: str) -> str:
     return resp
 
 
+def _is_reject(resp: str) -> bool:
+    """A shard answer the elastic client treats as retry-after-refresh
+    rather than an error: the map flipped (stale-epoch) or the keys are
+    mid-migration (frozen)."""
+    return resp.startswith("err stale-epoch") or resp.startswith(
+        "err frozen"
+    )
+
+
+class _Rejected(Exception):
+    """Internal: carries the ids a shard rejected (stale-epoch/frozen)
+    or could not be reached for, so the batch loop replays exactly
+    those under a refreshed map."""
+
+    def __init__(self, ids: np.ndarray):
+        super().__init__(f"{len(ids)} ids rejected")
+        self.ids = ids
+
+
 class ClusterClient(ParameterServerClient):
     """Worker-side handle over every shard.
 
@@ -139,8 +193,8 @@ class ClusterClient(ParameterServerClient):
 
     def __init__(
         self,
-        addresses: Sequence[Tuple[str, int]],
-        partitioner: Partitioner,
+        addresses: Optional[Sequence[Tuple[str, int]]] = None,
+        partitioner: Optional[Partitioner] = None,
         value_shape: Sequence[int] = (),
         *,
         window: int = 8,
@@ -149,31 +203,56 @@ class ClusterClient(ParameterServerClient):
         wire_format: str = "b64",
         registry=None,
         worker: Optional[str] = None,
+        membership=None,
+        hedge=None,
+        retry_timeout: float = 30.0,
+        retry_sleep_s: float = 0.002,
     ):
-        if len(addresses) != partitioner.num_shards:
-            raise ValueError(
-                f"{len(addresses)} shard addresses for a "
-                f"{partitioner.num_shards}-shard partitioner"
-            )
+        if membership is None:
+            if addresses is None or partitioner is None:
+                raise ValueError(
+                    "static client needs addresses + partitioner "
+                    "(or pass membership=)"
+                )
+            if len(addresses) != partitioner.num_shards:
+                raise ValueError(
+                    f"{len(addresses)} shard addresses for a "
+                    f"{partitioner.num_shards}-shard partitioner"
+                )
+            self._epoch: Optional[int] = None
+            self.partitioner = partitioner
+            self._addresses = [tuple(a) for a in addresses]
+        else:
+            view = membership.current()
+            self._epoch = view.epoch
+            self.partitioner = view.partitioner
+            self._addresses = [tuple(a) for a in view.addresses]
         if chunk < 1:
             raise ValueError(f"chunk={chunk}: must be >= 1")
         if wire_format not in ("text", "b64"):
             raise ValueError(f"wire_format={wire_format!r}: 'text' | 'b64'")
-        self.partitioner = partitioner
+        self.membership = membership
+        self.hedge = hedge
         self.value_shape = tuple(int(s) for s in value_shape)
         self.chunk = int(chunk)
         # b64 (default): exact fp32 bytes, ~100x cheaper than per-float
         # text (shard.py module docstring); "text" for debuggability
         self.wire_format = wire_format
-        self._conns = [
-            ShardConnection(h, p, window=window, timeout=timeout)
-            for h, p in addresses
-        ]
+        self._window = int(window)
+        self._timeout = float(timeout)
+        self.retry_timeout = float(retry_timeout)
+        self.retry_sleep_s = float(retry_sleep_s)
+        self._conns: Dict[Tuple[str, int], ShardConnection] = {}
         self.outputs: List[object] = []
         self._pending_pulls: List[int] = []
         self._pending_pushes: List[Tuple[int, np.ndarray]] = []
         self.pulls_coalesced = 0  # duplicate lanes saved from the wire
         self.pushes_coalesced = 0
+        self.rows_pushed = 0  # unique delta rows acked (the audit ledger)
+        self.frames_retried = 0  # frames replayed after a reject/refresh
+        # per-batch idempotence token base: unique per client instance
+        self._pid_base = f"{os.getpid():x}.{id(self):x}"
+        self._pid_counter = itertools.count()
         # unified plane (component=cluster): the pull RTT histogram and
         # the live in-flight window gauge
         if registry is not False:
@@ -188,14 +267,78 @@ class ClusterClient(ParameterServerClient):
                 "inflight_pulls", component="cluster", fn=self.inflight,
                 **labels,
             )
+            self._c_refresh = (
+                reg.counter(
+                    "elastic_epoch_refreshes_total", component="elastic",
+                    **labels,
+                )
+                if membership is not None
+                else None
+            )
         else:
             self._h_rtt = None
+            self._c_refresh = None
 
     # -- observability ------------------------------------------------------
     def inflight(self) -> int:
         """Outstanding pull/push frames across every shard connection —
         the live pipelining depth (<= window × shards)."""
-        return sum(c.inflight for c in self._conns)
+        return sum(c.inflight for c in list(self._conns.values()))
+
+    # -- connections / membership -------------------------------------------
+    def _conn_for(self, shard: int) -> ShardConnection:
+        addr = self._addresses[shard]
+        conn = self._conns.get(addr)
+        if conn is None:
+            conn = ShardConnection(
+                addr[0], addr[1], window=self._window,
+                timeout=self._timeout,
+            )
+            self._conns[addr] = conn
+        return conn
+
+    def _drop_conn(self, shard: int) -> None:
+        conn = self._conns.pop(self._addresses[shard], None)
+        if conn is not None:
+            conn.close()
+
+    def _refresh_membership(self) -> bool:
+        """Re-read the membership view; adopt a newer epoch's map +
+        addresses (closing connections to addresses that left).
+        Returns True when a new epoch was adopted."""
+        if self.membership is None:
+            return False
+        view = self.membership.current()
+        if view.epoch == self._epoch:
+            return False
+        self._epoch = view.epoch
+        self.partitioner = view.partitioner
+        new_addrs = [tuple(a) for a in view.addresses]
+        for addr in list(self._conns):
+            if addr not in new_addrs:
+                self._conns.pop(addr).close()
+        self._addresses = new_addrs
+        if self._c_refresh is not None:
+            self._c_refresh.inc()
+        return True
+
+    def _await_retry(self, deadline: float, attempt: int, what: str) -> None:
+        """Between replay rounds: refresh the view; if nothing changed,
+        sleep briefly (the flip/replacement is in flight) — bounded by
+        ``retry_timeout`` so a wedged cluster still surfaces."""
+        if self.membership is None:
+            raise RuntimeError(
+                f"{what}: shard rejected the frame and no membership "
+                f"view is attached (static client cannot re-route)"
+            )
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"{what}: retried past retry_timeout="
+                f"{self.retry_timeout}s without converging on a "
+                f"servable map"
+            )
+        if not self._refresh_membership():
+            time.sleep(min(0.05, self.retry_sleep_s * (1 + attempt)))
 
     # -- the batch surface --------------------------------------------------
     def pull_batch(
@@ -207,23 +350,44 @@ class ClusterClient(ParameterServerClient):
         ids_arr = np.asarray(ids)
         unique, inverse = coalesce_ids(ids_arr, mask)
         self.pulls_coalesced += int(ids_arr.size - unique.size)
-        by_shard = self._split(unique)
-        results: Dict[int, np.ndarray] = {}
-        self._for_each_shard(
-            by_shard,
-            lambda s, sids: results.__setitem__(s, self._pull_shard(s, sids)),
-        )
         width = int(np.prod(self.value_shape)) if self.value_shape else 1
         flat = np.empty((unique.size, width), dtype)
-        for s, sids in by_shard.items():
-            pos = np.searchsorted(unique, sids)
-            flat[pos] = results[s].reshape(len(sids), width)
+        todo = unique
+        deadline = time.monotonic() + self.retry_timeout
+        attempt = 0
+        while todo.size:
+            by_shard = self._split(todo)
+            rejected: List[np.ndarray] = []
+            rej_lock = threading.Lock()
+
+            def do(s, sids):
+                try:
+                    rows = self._pull_shard(s, sids)
+                except _Rejected as r:
+                    with rej_lock:
+                        rejected.append(r.ids)
+                    return
+                flat[np.searchsorted(unique, sids)] = rows.reshape(
+                    len(sids), width
+                )
+
+            self._for_each_shard(by_shard, do)
+            todo = (
+                np.concatenate(rejected) if rejected
+                else np.empty(0, np.int64)
+            )
+            if todo.size:
+                attempt += 1
+                self.frames_retried += 1
+                self._await_retry(deadline, attempt, "pull")
         out = flat.reshape(unique.shape + self.value_shape)
         return out[inverse]
 
     def push_batch(self, ids, deltas, mask=None) -> int:
         """Aggregate duplicate-id deltas, push each shard's share (in
-        parallel, pipelined); returns unique ids pushed."""
+        parallel, pipelined); returns unique ids pushed.  Under a
+        membership view every frame carries this batch's ``pid`` token,
+        so replays after a lost ack stay exactly-once shard-side."""
         ids_arr = np.asarray(ids)
         unique, summed = aggregate_deltas(ids_arr, np.asarray(deltas), mask)
         if unique.size == 0:
@@ -232,29 +396,61 @@ class ClusterClient(ParameterServerClient):
             (ids_arr.size if mask is None else int(np.asarray(mask).sum()))
             - unique.size
         )
-        by_shard = self._split(unique)
-        self._for_each_shard(
-            by_shard,
-            lambda s, sids: self._push_shard(
-                s, sids, summed[np.searchsorted(unique, sids)]
-            ),
+        # one pid per logical batch: (pid, id) identifies each row-push
+        # uniquely (unique is deduped), stable across replays/re-routes
+        pid = (
+            f"{self._pid_base}.{next(self._pid_counter)}"
+            if self.membership is not None
+            else None
         )
+        todo_ids, todo_rows = unique, summed
+        deadline = time.monotonic() + self.retry_timeout
+        attempt = 0
+        while todo_ids.size:
+            by_shard = self._split(todo_ids)
+            rejected: List[np.ndarray] = []
+            rej_lock = threading.Lock()
+
+            def do(s, sids):
+                rows = todo_rows[np.searchsorted(todo_ids, sids)]
+                try:
+                    self._push_shard(s, sids, rows, pid)
+                except _Rejected as r:
+                    with rej_lock:
+                        rejected.append(r.ids)
+
+            self._for_each_shard(by_shard, do)
+            done = todo_ids.size - sum(len(r) for r in rejected)
+            self.rows_pushed += int(done)
+            if rejected:
+                retry = np.sort(np.concatenate(rejected))
+                # keep the sorted-ids invariant: the per-shard row
+                # lookup above is a searchsorted against todo_ids
+                todo_rows = todo_rows[np.searchsorted(todo_ids, retry)]
+                todo_ids = retry
+                attempt += 1
+                self.frames_retried += 1
+                self._await_retry(deadline, attempt, "push")
+            else:
+                todo_ids = np.empty(0, np.int64)
         return int(unique.size)
 
     def flush(self) -> List[str]:
         """FLUSH every shard (WAL fsync + ack) — the explicit durability
         barrier a bound-0 round ends with when durability matters."""
         return [
-            _check_ok(c.request("flush"), f"flush shard {s}")
-            for s, c in enumerate(self._conns)
+            _check_ok(self._conn_for(s).request("flush"), f"flush shard {s}")
+            for s in range(self.partitioner.num_shards)
         ]
 
     def shard_stats(self) -> List[dict]:
         import json
 
         out = []
-        for s, c in enumerate(self._conns):
-            resp = _check_ok(c.request("stats"), f"stats shard {s}")
+        for s in range(self.partitioner.num_shards):
+            resp = _check_ok(
+                self._conn_for(s).request("stats"), f"stats shard {s}"
+            )
             out.append(json.loads(resp[3:]))
         return out
 
@@ -293,8 +489,11 @@ class ClusterClient(ParameterServerClient):
         return n
 
     def close(self) -> None:
-        for c in self._conns:
+        for c in list(self._conns.values()):
             c.close()
+        self._conns = {}
+        if self.hedge is not None:
+            self.hedge.close()
 
     # -- internals ----------------------------------------------------------
     def _split(self, unique_ids: np.ndarray) -> Dict[int, np.ndarray]:
@@ -329,18 +528,66 @@ class ClusterClient(ParameterServerClient):
         if errors:
             raise errors[0]
 
+    def _frame_suffix(self, pid: Optional[str] = None) -> str:
+        suffix = ""
+        if pid is not None:
+            suffix += f" pid={pid}"
+        if self._epoch is not None:
+            suffix += f" e={self._epoch}"
+        return suffix
+
+    def _request_frames(
+        self, shard: int, sids: np.ndarray, lines: List[str], *,
+        hedgeable: bool,
+    ) -> List[str]:
+        """Send one shard's frames; a connection-level failure in
+        elastic mode becomes a :class:`_Rejected` (drop the cached
+        connection, let the batch loop refresh + replay) instead of an
+        error — the client sees latency while the controller replaces
+        the shard."""
+        try:
+            conn = self._conn_for(shard)
+            if hedgeable and self.hedge is not None:
+                addr = self._addresses[shard]
+
+                def on_backup_won(spare_conn):
+                    # the still-draining primary must never be reused
+                    # (one reader per line-protocol connection): the
+                    # clean spare takes its slot
+                    old = self._conns.pop(addr, None)
+                    if old is not None:
+                        old.close()
+                    self._conns[addr] = spare_conn
+
+                return self.hedge.request_many(
+                    conn,
+                    lambda: ShardConnection(
+                        addr[0], addr[1], window=self._window,
+                        timeout=self._timeout,
+                    ),
+                    lines,
+                    on_backup_won,
+                )
+            return conn.request_many(lines)
+        except OSError:
+            if self.membership is None:
+                raise
+            self._drop_conn(shard)
+            raise _Rejected(sids) from None
+
     def _pull_shard(self, shard: int, ids: np.ndarray) -> np.ndarray:
-        conn = self._conns[shard]
         chunks = [
             ids[i: i + self.chunk] for i in range(0, len(ids), self.chunk)
         ]
+        suffix = self._frame_suffix()
         lines = [
             "pull " + ",".join(str(int(i)) for i in c)
-            + (" b64" if self.wire_format == "b64" else "")
+            + (" b64" if self.wire_format == "b64" else " text")
+            + suffix
             for c in chunks
         ]
         t0 = time.perf_counter()
-        resps = conn.request_many(lines)
+        resps = self._request_frames(shard, ids, lines, hedgeable=True)
         if self._h_rtt is not None:
             # one observation per chunk frame: the pipelined per-frame
             # turnaround, amortised (total wall / frames)
@@ -348,7 +595,11 @@ class ClusterClient(ParameterServerClient):
             for _ in lines:
                 self._h_rtt.observe(per)
         rows = []
+        rejected: List[np.ndarray] = []
         for resp, c in zip(resps, chunks):
+            if _is_reject(resp) and self.membership is not None:
+                rejected.append(c)
+                continue
             _check_ok(resp, f"pull shard {shard}")
             _, _, body = resp.partition(" ")
             _, _, body = body.partition(" ")  # strip "n=<k>"
@@ -359,26 +610,45 @@ class ClusterClient(ParameterServerClient):
                     f"{len(c)} ids"
                 )
             rows.append(vals)
+        if rejected:
+            # partial answers cannot scatter into the output without
+            # per-chunk bookkeeping; pulls are idempotent, so replay
+            # the shard's whole id set under the refreshed map
+            raise _Rejected(ids)
         return np.concatenate(rows) if rows else np.empty(
             (0,) + self.value_shape, np.float32
         )
 
     def _push_shard(
-        self, shard: int, ids: np.ndarray, deltas: np.ndarray
+        self,
+        shard: int,
+        ids: np.ndarray,
+        deltas: np.ndarray,
+        pid: Optional[str] = None,
     ) -> None:
-        conn = self._conns[shard]
+        suffix = self._frame_suffix(pid)
         lines = []
+        chunks = []
         for i in range(0, len(ids), self.chunk):
             c_ids = ids[i: i + self.chunk]
             c_del = deltas[i: i + self.chunk]
+            chunks.append(c_ids)
             lines.append(
                 "push "
                 + ",".join(str(int(x)) for x in c_ids)
                 + " "
                 + format_rows(c_del, self.wire_format)
+                + suffix
             )
-        for resp in conn.request_many(lines):
+        resps = self._request_frames(shard, ids, lines, hedgeable=False)
+        rejected: List[np.ndarray] = []
+        for resp, c_ids in zip(resps, chunks):
+            if _is_reject(resp) and self.membership is not None:
+                rejected.append(c_ids)
+                continue
             _check_ok(resp, f"push shard {shard}")
+        if rejected:
+            raise _Rejected(np.concatenate(rejected))
 
 
 __all__ = ["ClusterClient", "ShardConnection"]

@@ -154,25 +154,45 @@ class ClusterDriver:
         self._step_fn = None
 
     # -- lifecycle ---------------------------------------------------------
+    def _wal_dir_for(self, shard_id: int) -> Optional[str]:
+        cfg = self.config
+        return (
+            None if cfg.wal_dir is None
+            else f"{cfg.wal_dir}/shard-{shard_id}"
+        )
+
+    def _build_shard(
+        self, shard_id: int, partitioner: Optional[Partitioner] = None
+    ) -> Tuple[ParamShard, ShardServer]:
+        """One shard + its TCP front end (the elastic driver reuses
+        this for scale-out spin-up and dead-shard replacement)."""
+        cfg = self.config
+        shard = ParamShard(
+            shard_id,
+            partitioner if partitioner is not None else self.partitioner,
+            self.value_shape,
+            init_fn=self._init_fn,
+            wal_dir=self._wal_dir_for(shard_id),
+            registry=self.registry if self.registry is not None else False,
+        )
+        server = ShardServer(
+            shard, cfg.host, 0, supervised=cfg.supervised
+        ).start()
+        return shard, server
+
+    def _on_servers_started(self) -> None:
+        """Hook between shard spin-up and client construction (the
+        elastic driver creates its membership service here)."""
+
     def start(self) -> "ClusterDriver":
         if self._started:
             return self
         cfg = self.config
         for s in range(cfg.num_shards):
-            wal_dir = (
-                None if cfg.wal_dir is None
-                else f"{cfg.wal_dir}/shard-{s}"
-            )
-            shard = ParamShard(
-                s, self.partitioner, self.value_shape,
-                init_fn=self._init_fn, wal_dir=wal_dir,
-                registry=self.registry if self.registry is not None else False,
-            )
-            server = ShardServer(
-                shard, cfg.host, 0, supervised=cfg.supervised
-            ).start()
+            shard, server = self._build_shard(s)
             self.shards.append(shard)
             self.servers.append(server)
+        self._on_servers_started()
         self._clients = [
             self._make_client(worker=str(w))
             for w in range(cfg.num_workers)

@@ -183,12 +183,28 @@ class ConsistentHashPartitioner(Partitioner):
         return np.argmax(scores, axis=-1).astype(np.int32)
 
     def grown(self, num_shards: int) -> "ConsistentHashPartitioner":
-        """The same map with more shards (same seed) — what a resize
+        """The same map with more shards (same seed) — what a scale-out
         deploys; existing keys move only onto the new shards."""
         if num_shards < self.num_shards:
             raise ValueError(
                 f"grown({num_shards}) must not shrink below "
-                f"{self.num_shards}; build a fresh partitioner to scale in"
+                f"{self.num_shards}; use shrunk() to scale in"
+            )
+        return ConsistentHashPartitioner(
+            self.capacity, num_shards, seed=self.seed
+        )
+
+    def shrunk(self, num_shards: int) -> "ConsistentHashPartitioner":
+        """The same map with the HIGHEST-indexed shards removed (same
+        seed) — the scale-in inverse of :meth:`grown`.  Rendezvous
+        scoring makes this exactly symmetric: dropping the last salt
+        only ever LOWERS a key's argmax back onto a survivor, so keys
+        move only OFF the retired shards; every surviving shard keeps
+        exactly its old keys plus inherited ones (the drain-and-retire
+        property migration relies on)."""
+        if not 1 <= num_shards <= self.num_shards:
+            raise ValueError(
+                f"shrunk({num_shards}) must be in [1, {self.num_shards}]"
             )
         return ConsistentHashPartitioner(
             self.capacity, num_shards, seed=self.seed

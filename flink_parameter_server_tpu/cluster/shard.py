@@ -9,17 +9,45 @@ serving plane (``serving/server.py``) and the ingest edge
 :class:`~..utils.net.LineServer`.
 
 Wire protocol (one request line → one response line, in order, per
-connection)::
+connection).  Every verb accepts trailing ``key=value`` options;
+``e=<epoch>`` tags the frame with the client's partition-map epoch and
+``pid=<token>`` makes a push idempotent (exactly-once across retries —
+see below)::
 
-    pull <id1,id2,...> [text|b64]         # global ids + answer format
-    push <id1,id2,...> <payload>          # deltas, one row per id
-    flush                                 # fsync the WAL, ack counters
-    stats                                 # one-line JSON shard stats
+    pull <id1,id2,...> [text|b64] [e=<n>]    # global ids + answer format
+    push <id1,id2,...> <payload> [pid=<t>] [e=<n>]  # deltas, 1 row/id
+    xfer <id1,id2,...>                       # atomic (rows, seq) snapshot
+    load <id1,id2,...> <payload>             # row ASSIGNMENT (migration)
+    flush                                    # fsync the WAL, ack counters
+    stats                                    # one-line JSON shard stats
 
     ok n=<k> <payload>                    # pull answer
     ok applied=<k> seq=<n>                # push answer
+    ok n=<k> seq=<s> <payload>            # xfer answer (always b64)
+    ok loaded=<k> seq=<n>                 # load answer
     ok pushes=<n> wal_records=<m>         # flush answer
-    err <reason>                          # bad-request | crashed | internal
+    err <reason>      # bad-request | crashed | stale-epoch | frozen
+                      # | internal
+
+Epoch fencing (the elastic/ membership protocol, docs/elastic.md): a
+shard pins the partition-map epoch it serves.  A push whose frame
+epoch is OLDER than the shard's is rejected with ``err stale-epoch``
+— a map flip can therefore never mix routings: the client refreshes
+its membership view and replays the frame against the new map.  A
+frame from a NEWER epoch is accepted when its ids route here under
+either map (the flip is mid-flight; ownership under the new map is a
+subset of what this shard already holds), and answered
+``err stale-epoch`` when they don't.  During a key migration the
+moving range is FROZEN: pushes touching it get ``err frozen`` (retry
+shortly — the flip is imminent); pulls and pushes of non-moving keys
+never block.
+
+Exactly-once pushes: a frame carrying ``pid=<token>`` is deduplicated
+per ``(pid, id)`` against a bounded window that survives crashes (the
+pairs ride the WAL records and the install-epoch snapshot, and
+migration hands the moving range's pairs to the new owner), so a
+client retry after a lost ack — shard died AFTER applying, BEFORE
+answering — is acked without double-applying.
 
 Row payloads come in two self-describing encodings, both EXACT (a
 pulled row is bitwise the stored fp32 row — what lets a bound-0
@@ -72,6 +100,24 @@ class ShardCrashed(RuntimeError):
     down the DEVICE branch."""
 
     failure_class = "device"
+
+
+class StaleEpoch(RuntimeError):
+    """Frame epoch vs shard epoch disagree in a way that cannot be
+    served (an old-epoch write, or ids this shard does not own under a
+    mixed-flight flip).  Carries the shard's current epoch so the wire
+    answer tells the client what to catch up to."""
+
+    def __init__(self, shard_epoch: int, detail: str = ""):
+        super().__init__(
+            f"stale epoch (shard at {shard_epoch}){': ' + detail if detail else ''}"
+        )
+        self.shard_epoch = int(shard_epoch)
+
+
+class FrozenKeys(RuntimeError):
+    """The push touches a key range frozen for migration — retry
+    shortly; the epoch flip that re-homes the range is imminent."""
 
 
 def format_rows(rows: np.ndarray, encoding: str = "text") -> str:
@@ -171,7 +217,18 @@ class ParamShard:
         self.pushes_applied = 0
         self.pulls_served = 0
         self.restarts = 0
+        self.rows_applied = 0  # delta rows actually applied (post-dedupe)
+        self.loads_applied = 0  # rows assigned via load (migration)
         self._push_seq = 0
+        # elastic state: the partition-map epoch this shard serves, the
+        # key range frozen for an in-flight migration, rows staged for
+        # keys this shard will own only after the NEXT epoch flip, and
+        # the bounded exactly-once (pid, id) dedupe window
+        self.epoch = 0
+        self._frozen: Optional[np.ndarray] = None
+        self._staged: dict = {}
+        self._applied_pairs: dict = {}  # insertion-ordered set w/ cap
+        self.pid_window = 1 << 16
         self.store = None
         # host-side read mirror of the slice, rebuilt lazily after each
         # push: pulls are then one numpy fancy-index instead of an
@@ -231,17 +288,64 @@ class ParamShard:
         """Re-apply every intact WAL record in sequence order; returns
         the number replayed.  Replay bypasses the WAL append (the
         records are already durable) but goes through the same
-        scatter-add, so the rebuilt slice is bitwise the logged one."""
+        scatter-add, so the rebuilt slice is bitwise the logged one.
+
+        Records come in three kinds: ``push`` (delta rows — the
+        default), ``load`` (row assignments from a migration), and
+        ``snapshot`` (the full owned slice, written at each epoch
+        flip).  A snapshot SUPERSEDES everything before it — replay
+        starts at the newest one, which is also what makes replay safe
+        across reshardings: pre-flip records may reference ids this
+        shard no longer owns, and the snapshot barrier keeps them out
+        of the replay window."""
+        records = self._wal.replay()
+        start = 0
+        for i, rec in enumerate(records):
+            p = rec.payload
+            if isinstance(p, dict) and p.get("kind") == "snapshot":
+                start = i
         n = 0
-        for rec in self._wal.replay():
-            payload = rec.payload
-            self._apply(
-                np.asarray(payload["ids"], np.int64),
-                np.asarray(payload["deltas"], np.float32),
-            )
+        for rec in records[start:]:
+            p = rec.payload
+            kind = p.get("kind", "push")
+            if kind == "snapshot":
+                self._restore_snapshot(p)
+            elif kind == "load":
+                self._assign(
+                    np.asarray(p["ids"], np.int64),
+                    np.asarray(p["values"], np.float32),
+                )
+            else:
+                ids = np.asarray(p["ids"], np.int64)
+                self._apply(ids, np.asarray(p["deltas"], np.float32))
+                if p.get("pid") is not None:
+                    self._remember_pairs(p["pid"], ids)
             self._push_seq = rec.end_step
             n += 1
         return n
+
+    def _restore_snapshot(self, payload: dict) -> None:
+        """Rebuild the slice from an epoch-flip snapshot record: the
+        logged ids must be exactly the partitioner's owned set for this
+        shard (the shard was reconstructed with the post-flip map)."""
+        import jax.numpy as jnp
+
+        from ..core.store import ShardedParamStore
+
+        ids = np.asarray(payload["ids"], np.int64)
+        if not np.array_equal(ids, self.owned):
+            raise RuntimeError(
+                f"shard {self.shard_id}: WAL snapshot owns {len(ids)} "
+                f"rows but the partitioner assigns {len(self.owned)} — "
+                f"replaying with a different map than the one the "
+                f"snapshot was taken under"
+            )
+        values = np.asarray(payload["values"], np.float32)
+        self.store = ShardedParamStore.from_values(jnp.asarray(values))
+        self._host_mirror = None
+        for pair in payload.get("pairs", ()):
+            self._applied_pairs[(pair[0], int(pair[1]))] = None
+        self._trim_pairs()
 
     def _apply(self, global_ids: np.ndarray, deltas: np.ndarray) -> None:
         import jax.numpy as jnp
@@ -269,12 +373,74 @@ class ParamShard:
         self._host_mirror = None  # mirror is stale past this point
         self.pushes_applied += 1
 
+    def _assign(self, global_ids: np.ndarray, values: np.ndarray) -> None:
+        """Row ASSIGNMENT (the migration load path): owned ids are set
+        bitwise in the local slice; ids this shard will own only after
+        the next epoch flip are STAGED and folded in at
+        :meth:`install_epoch` (scale-in hands a survivor rows it cannot
+        address under the pre-flip map)."""
+        import jax.numpy as jnp
+
+        from ..core.store import ShardedParamStore
+
+        ids = np.asarray(global_ids, np.int64)
+        values = np.asarray(values, np.float32)
+        mine = self.partitioner.shard_of(ids) == self.shard_id
+        for gid, row in zip(ids[~mine], values[~mine]):
+            self._staged[int(gid)] = np.array(row, np.float32)
+        if mine.any():
+            local = self.partitioner.to_local(self.shard_id, ids[mine])
+            # assign through the host mirror: a bulk load arrives in
+            # many chunks, and a device round trip per chunk would
+            # dominate migration wall time; jnp.asarray copies the
+            # mirror to the device, so the mirror stays valid after.
+            # (np.array, not asarray: the zero-copy view of a jax
+            # buffer is read-only)
+            if (
+                self._host_mirror is None
+                or not self._host_mirror.flags.writeable
+            ):
+                self._host_mirror = np.array(self.store.values())
+            self._host_mirror[local] = values[mine].astype(
+                self._host_mirror.dtype
+            )
+            self.store = ShardedParamStore.from_values(
+                jnp.asarray(self._host_mirror)
+            )
+
+    def _remember_pairs(self, pid: str, ids: np.ndarray) -> None:
+        for gid in ids:
+            self._applied_pairs[(pid, int(gid))] = None
+        self._trim_pairs()
+
+    def _trim_pairs(self) -> None:
+        while len(self._applied_pairs) > self.pid_window:
+            self._applied_pairs.pop(next(iter(self._applied_pairs)))
+
+    def _check_alive(self) -> None:
+        if self.store is None:
+            raise ShardCrashed(f"shard {self.shard_id} has no live slice")
+
+    def _route(self, ids: np.ndarray, epoch: Optional[int]) -> np.ndarray:
+        """``to_local`` with epoch-aware failure: a routing miss under a
+        mismatched frame epoch is the mixed-flight flip, not a protocol
+        bug — answer stale-epoch so the client refreshes and replays."""
+        try:
+            return self.partitioner.to_local(self.shard_id, ids)
+        except KeyError:
+            if epoch is not None and epoch != self.epoch:
+                raise StaleEpoch(
+                    self.epoch, "ids not owned under the frame's map"
+                ) from None
+            raise
+
     # -- the shard protocol ------------------------------------------------
-    def pull(self, global_ids: np.ndarray) -> np.ndarray:
+    def pull(
+        self, global_ids: np.ndarray, *, epoch: Optional[int] = None
+    ) -> np.ndarray:
         with self._lock:
-            if self.store is None:
-                raise ShardCrashed(f"shard {self.shard_id} has no live slice")
-            local = self.partitioner.to_local(self.shard_id, global_ids)
+            self._check_alive()
+            local = self._route(np.asarray(global_ids, np.int64), epoch)
             if self._host_mirror is None:
                 self._host_mirror = np.asarray(self.store.values())
             vals = self._host_mirror[local]
@@ -283,26 +449,53 @@ class ParamShard:
                 self._c_pulls.inc()
             return vals
 
-    def push(self, global_ids: np.ndarray, deltas: np.ndarray) -> int:
+    def push(
+        self,
+        global_ids: np.ndarray,
+        deltas: np.ndarray,
+        *,
+        epoch: Optional[int] = None,
+        pid: Optional[str] = None,
+    ) -> int:
         """WRITE-AHEAD then apply; returns the shard's push sequence
-        number after this push."""
+        number after this push.  ``epoch`` fences against stale maps
+        (old-epoch writes are rejected, never absorbed); ``pid`` makes
+        the push idempotent per ``(pid, id)`` — the already-applied
+        subset of a retried frame is acked without re-applying."""
         with self._lock:
-            if self.store is None:
-                raise ShardCrashed(f"shard {self.shard_id} has no live slice")
+            self._check_alive()
+            if epoch is not None and epoch < self.epoch:
+                raise StaleEpoch(self.epoch, "old-epoch write rejected")
+            ids = np.asarray(global_ids, np.int64)
+            deltas = np.asarray(deltas, np.float32)
+            if self._frozen is not None and np.isin(
+                ids, self._frozen
+            ).any():
+                raise FrozenKeys(
+                    f"shard {self.shard_id}: push touches a key range "
+                    f"frozen for migration"
+                )
             # route check first: a mis-routed id must fail the request
             # BEFORE it is logged (replaying a bad frame would re-raise
             # forever)
-            self.partitioner.to_local(self.shard_id, global_ids)
-            if self._wal is not None:
-                self._wal.append(
-                    self._push_seq, 1,
-                    {
-                        "ids": np.asarray(global_ids, np.int64),
-                        "deltas": np.asarray(deltas, np.float32),
-                    },
+            self._route(ids, epoch)
+            if pid is not None:
+                fresh = np.asarray(
+                    [(pid, int(g)) not in self._applied_pairs for g in ids]
                 )
+                if not fresh.any():
+                    return self._push_seq  # full duplicate: ack only
+                ids, deltas = ids[fresh], deltas[fresh]
+            if self._wal is not None:
+                payload = {"ids": ids, "deltas": deltas}
+                if pid is not None:
+                    payload["pid"] = pid
+                self._wal.append(self._push_seq, 1, payload)
             self._push_seq += 1
-            self._apply(global_ids, deltas)
+            self._apply(ids, deltas)
+            self.rows_applied += int(len(ids))
+            if pid is not None:
+                self._remember_pairs(pid, ids)
             if self._c_pushes is not None:
                 self._c_pushes.inc()
             return self._push_seq
@@ -327,6 +520,187 @@ class ParamShard:
             if self.store is None:
                 raise ShardCrashed(f"shard {self.shard_id} has no live slice")
             return np.asarray(self.store.values())
+
+    # -- elastic membership / migration (docs/elastic.md) --------------------
+    def snapshot_rows(
+        self, global_ids: np.ndarray
+    ) -> Tuple[np.ndarray, int]:
+        """ATOMIC ``(rows, seq)`` read for migration: the returned rows
+        reflect exactly the pushes with sequence ≤ ``seq`` — the WAL
+        tail ``> seq`` is precisely what the new owner still needs
+        (``xfer`` on the wire).  One lock acquisition covers both
+        reads; rows are a copy."""
+        with self._lock:
+            self._check_alive()
+            local = self.partitioner.to_local(
+                self.shard_id, np.asarray(global_ids, np.int64)
+            )
+            if self._host_mirror is None:
+                self._host_mirror = np.asarray(self.store.values())
+            return self._host_mirror[local].copy(), self._push_seq
+
+    def assign_rows(
+        self, global_ids: np.ndarray, values: np.ndarray
+    ) -> int:
+        """WAL-logged row ASSIGNMENT (the ``load`` verb): migrated rows
+        land bitwise-equal — no delta arithmetic touches them — and the
+        log record (kind=``load``) replays the assignment on a crash.
+        Ids this shard only owns under the NEXT map are staged (see
+        :meth:`_assign`); returns the shard's sequence number after."""
+        with self._lock:
+            self._check_alive()
+            ids = np.asarray(global_ids, np.int64)
+            values = np.asarray(values, np.float32)
+            if len(ids) != len(values):
+                raise ValueError(
+                    f"{len(ids)} ids but {len(values)} value rows"
+                )
+            if self._wal is not None:
+                self._wal.append(
+                    self._push_seq, 1,
+                    {"kind": "load", "ids": ids, "values": values},
+                )
+            self._push_seq += 1
+            self._assign(ids, values)
+            self.loads_applied += int(len(ids))
+            return self._push_seq
+
+    def freeze(self, global_ids) -> None:
+        """Freeze a moving key range: pushes touching it raise
+        :class:`FrozenKeys` until :meth:`install_epoch` (or
+        :meth:`unfreeze`).  Pulls, and pushes of every other key, are
+        untouched — non-moving keys never block."""
+        with self._lock:
+            ids = np.unique(np.asarray(global_ids, np.int64))
+            self._frozen = (
+                ids if self._frozen is None
+                else np.union1d(self._frozen, ids)
+            )
+
+    def unfreeze(self) -> None:
+        with self._lock:
+            self._frozen = None
+
+    def install_epoch(self, epoch: int, partitioner: Partitioner) -> None:
+        """The flip: adopt the new partition map at ``epoch``.  The
+        slice is compacted to the new owned set — rows kept bitwise,
+        staged rows (scale-in inheritance) folded in — the freeze
+        lifts, and a ``snapshot`` barrier record makes the post-flip
+        WAL self-contained (replay never crosses a resharding)."""
+        import jax.numpy as jnp
+
+        from ..core.store import ShardedParamStore
+
+        with self._lock:
+            self._check_alive()
+            if int(epoch) <= self.epoch:
+                raise ValueError(
+                    f"install_epoch({epoch}): shard {self.shard_id} "
+                    f"already at epoch {self.epoch} (epochs are monotone)"
+                )
+            new_owned = partitioner.owned_ids(self.shard_id)
+            mirror = np.asarray(self.store.values())
+            pos = np.searchsorted(self.owned, new_owned)
+            have = (pos < len(self.owned)) & (
+                self.owned[np.minimum(pos, len(self.owned) - 1)]
+                == new_owned
+            ) if len(self.owned) else np.zeros(len(new_owned), bool)
+            rows = np.empty(
+                (len(new_owned),) + mirror.shape[1:], mirror.dtype
+            )
+            rows[have] = mirror[pos[have]]
+            for j in np.nonzero(~have)[0]:
+                gid = int(new_owned[j])
+                if gid not in self._staged:
+                    raise KeyError(
+                        f"shard {self.shard_id}: epoch {epoch} assigns "
+                        f"id {gid} here but no row was migrated in"
+                    )
+                rows[j] = self._staged[gid]
+            self.partitioner = partitioner
+            self.owned = new_owned
+            self.store = ShardedParamStore.from_values(jnp.asarray(rows))
+            self._host_mirror = None
+            self._staged = {}
+            self._frozen = None
+            self.epoch = int(epoch)
+            if self._wal is not None:
+                barrier = self._push_seq
+                self._wal.append(
+                    barrier, 1,
+                    {
+                        "kind": "snapshot",
+                        "ids": new_owned,
+                        "values": rows,
+                        "pairs": list(self._applied_pairs),
+                    },
+                )
+                self._push_seq += 1
+                # older segments are fully superseded by the barrier —
+                # best-effort bound on the log (whole segments only)
+                self._wal.truncate_through(barrier)
+
+    def retire(self, epoch: int) -> None:
+        """Drain-and-retire terminal state: the shard stops accepting
+        writes (everything frozen, epoch bumped so old-epoch frames
+        answer stale-epoch) but keeps serving reads until its server is
+        stopped — in-flight old-map pulls drain instead of erroring."""
+        with self._lock:
+            self.epoch = int(epoch)
+            self._frozen = np.asarray(self.owned, np.int64)
+
+    def applied_pairs_for(self, global_ids) -> list:
+        """The exactly-once ``(pid, id)`` pairs covering the given ids
+        — migration hands these to the new owner so a retried push of a
+        moved key stays deduplicated across the flip."""
+        with self._lock:
+            wanted = set(int(g) for g in np.asarray(global_ids).reshape(-1))
+            return [
+                pair for pair in self._applied_pairs if pair[1] in wanted
+            ]
+
+    def merge_applied_pairs(self, pairs) -> None:
+        with self._lock:
+            for pid, gid in pairs:
+                self._applied_pairs[(pid, int(gid))] = None
+            self._trim_pairs()
+
+    def peek_rows(self, global_ids) -> np.ndarray:
+        """Read rows for migration verification regardless of where
+        they live: owned rows from the slice, incoming rows from the
+        staging area — the pre-flip view of what :meth:`install_epoch`
+        will own."""
+        with self._lock:
+            self._check_alive()
+            ids = np.asarray(global_ids, np.int64)
+            if self._host_mirror is None:
+                self._host_mirror = np.asarray(self.store.values())
+            mine = self.partitioner.shard_of(ids) == self.shard_id
+            out = np.empty(
+                (len(ids),) + self._host_mirror.shape[1:],
+                self._host_mirror.dtype,
+            )
+            if mine.any():
+                local = self.partitioner.to_local(self.shard_id, ids[mine])
+                out[mine] = self._host_mirror[local]
+            for j in np.nonzero(~mine)[0]:
+                gid = int(ids[j])
+                if gid not in self._staged:
+                    raise KeyError(
+                        f"shard {self.shard_id}: id {gid} neither owned "
+                        f"nor staged"
+                    )
+                out[j] = self._staged[gid]
+            return out
+
+    def wal_tail(self, after_seq: int, global_ids=None) -> list:
+        """The shard's WAL records after ``after_seq`` (push-sequence
+        space), keyed-filtered to ``global_ids`` — the migration tail
+        (:meth:`~..resilience.wal.UpdateWAL.replay_range`).  Empty when
+        the shard runs without a WAL."""
+        if self._wal is None:
+            return []
+        return self._wal.replay_range(after_seq, global_ids)
 
     # -- failure / recovery -------------------------------------------------
     def crash(self) -> None:
@@ -359,6 +733,13 @@ class ParamShard:
                 "push_seq": self._push_seq,
                 "restarts": self.restarts,
                 "alive": self.store is not None,
+                "epoch": self.epoch,
+                "rows_applied": self.rows_applied,
+                "loads_applied": self.loads_applied,
+                "frozen": (
+                    0 if self._frozen is None else int(len(self._frozen))
+                ),
+                "staged": len(self._staged),
             }
 
     def close(self) -> None:
@@ -429,46 +810,103 @@ class ShardServer(LineServer):
                     return "err crashed: restart budget exhausted"
                 time.sleep(self.policy.backoff_s(attempt, self._rng))
                 self.shard.restart()
+            except StaleEpoch as e:
+                return f"err stale-epoch epoch={e.shard_epoch}"
+            except FrozenKeys:
+                return "err frozen"
             except (ValueError, KeyError) as e:
                 return f"err bad-request: {e}"
             except Exception as e:  # noqa: BLE001 — protocol boundary
                 return f"err internal: {type(e).__name__}: {e}"
 
+    @staticmethod
+    def _parse_opts(toks) -> dict:
+        """Trailing ``key=value`` option tokens (``e=<epoch>``,
+        ``pid=<token>``)."""
+        opts = {}
+        for t in toks:
+            k, sep, v = t.partition("=")
+            if not sep or not k:
+                raise ValueError(f"bad option token {t!r} (key=value)")
+            opts[k] = v
+        epoch = opts.pop("e", None)
+        if epoch is not None:
+            try:
+                opts["e"] = int(epoch)
+            except ValueError:
+                raise ValueError(f"e={epoch!r}: epoch must be an integer")
+        return opts
+
     def _dispatch(self, line: str) -> str:
-        parts = line.split(None, 2)
-        cmd = parts[0].lower()
+        toks = line.split()
+        cmd = toks[0].lower()
         if cmd == "pull":
-            if len(parts) not in (2, 3):
-                raise ValueError("usage: pull <id1,id2,...> [text|b64]")
-            enc = parts[2].strip().lower() if len(parts) == 3 else "text"
-            if enc not in ("text", "b64"):
-                raise ValueError(f"pull format {enc!r}: 'text' | 'b64'")
-            ids = parse_ids(parts[1])
-            vals = self.shard.pull(ids)
+            if len(toks) < 2:
+                raise ValueError(
+                    "usage: pull <id1,id2,...> [text|b64] [e=<epoch>]"
+                )
+            rest = toks[2:]
+            enc = "text"
+            if rest and rest[0].lower() in ("text", "b64"):
+                enc = rest[0].lower()
+                rest = rest[1:]
+            elif rest and "=" not in rest[0]:
+                raise ValueError(f"pull format {rest[0]!r}: 'text' | 'b64'")
+            opts = self._parse_opts(rest)
+            ids = parse_ids(toks[1])
+            vals = self.shard.pull(ids, epoch=opts.get("e"))
             return f"ok n={len(ids)} {format_rows(vals, enc)}"
         if cmd == "push":
-            if len(parts) != 3:
-                raise ValueError("usage: push <id1,id2,...> <row1;row2;...>")
-            ids = parse_ids(parts[1])
-            deltas = parse_rows(parts[2], self.shard.value_shape)
+            if len(toks) < 3:
+                raise ValueError(
+                    "usage: push <id1,id2,...> <row1;row2;...> "
+                    "[pid=<token>] [e=<epoch>]"
+                )
+            ids = parse_ids(toks[1])
+            deltas = parse_rows(toks[2], self.shard.value_shape)
             if len(deltas) != len(ids):
                 raise ValueError(
                     f"{len(ids)} ids but {len(deltas)} delta rows"
                 )
-            seq = self.shard.push(ids, deltas)
+            opts = self._parse_opts(toks[3:])
+            seq = self.shard.push(
+                ids, deltas, epoch=opts.get("e"), pid=opts.get("pid"),
+            )
             return f"ok applied={len(ids)} seq={seq}"
+        if cmd == "xfer":
+            if len(toks) != 2:
+                raise ValueError("usage: xfer <id1,id2,...>")
+            ids = parse_ids(toks[1])
+            vals, seq = self.shard.snapshot_rows(ids)
+            return f"ok n={len(ids)} seq={seq} {format_rows(vals, 'b64')}"
+        if cmd == "load":
+            if len(toks) < 3:
+                raise ValueError("usage: load <id1,id2,...> <payload>")
+            ids = parse_ids(toks[1])
+            vals = parse_rows(toks[2], self.shard.value_shape)
+            if len(vals) != len(ids):
+                raise ValueError(
+                    f"{len(ids)} ids but {len(vals)} value rows"
+                )
+            self._parse_opts(toks[3:])  # validate; load is controller-driven
+            seq = self.shard.assign_rows(ids, vals)
+            return f"ok loaded={len(ids)} seq={seq}"
         if cmd == "flush":
             f = self.shard.flush()
             return f"ok pushes={f['pushes']} wal_records={f['wal_records']}"
         if cmd == "stats":
             return "ok " + json.dumps(self.shard.stats())
-        raise ValueError(f"unknown command {cmd!r} (pull|push|flush|stats)")
+        raise ValueError(
+            f"unknown command {cmd!r} (pull|push|xfer|load|flush|stats)"
+        )
 
 
 __all__ = [
     "ParamShard",
     "ShardServer",
     "ShardCrashed",
+    "StaleEpoch",
+    "FrozenKeys",
     "format_rows",
     "parse_rows",
     "parse_ids",

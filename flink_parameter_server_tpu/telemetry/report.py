@@ -111,6 +111,30 @@ def build_run_report(
                 _sum_counter(snap, "stall_episodes_total")
             ),
         },
+        "elastic": {
+            "epoch": _find(snap, "elastic_epoch", component="elastic"),
+            "epoch_flips": int(
+                _sum_counter(snap, "elastic_epoch_flips_total")
+            ),
+            "epoch_refreshes": int(
+                _sum_counter(snap, "elastic_epoch_refreshes_total")
+            ),
+            "rows_migrated": int(
+                _sum_counter(snap, "elastic_rows_migrated_total")
+            ),
+            "migration_stall": _hist_percentiles(
+                reg, "elastic_migration_stall_seconds"
+            ),
+            "hedged_pulls": int(
+                _sum_counter(snap, "elastic_hedged_pulls_total")
+            ),
+            "hedges_won": int(
+                _sum_counter(snap, "elastic_hedges_won_total")
+            ),
+            "shard_replacements": int(
+                _sum_counter(snap, "elastic_shard_replacements_total")
+            ),
+        },
     }
     if extra:
         report["extra"] = dict(extra)
@@ -129,6 +153,7 @@ def _default_platform() -> str:
 def render_markdown(report: Dict[str, Any]) -> str:
     t, s = report["train"], report["serving"]
     i, r = report["ingest"], report["recovery"]
+    e = report.get("elastic", {})
     pp, sl = t["pull_push"], s["latency"]
 
     def fmt(v, unit=""):
@@ -162,6 +187,20 @@ def render_markdown(report: Dict[str, Any]) -> str:
         f"{r['replayed_steps']} / {r['dropped_steps']} |",
         f"| stall episodes | {r['stall_episodes']} |",
     ]
+    if e:
+        ms = e.get("migration_stall", {})
+        lines += [
+            f"| elastic epoch (flips / client refreshes) | "
+            f"{fmt(e['epoch'])} ({e['epoch_flips']} / "
+            f"{e['epoch_refreshes']}) |",
+            f"| rows migrated | {e['rows_migrated']} |",
+            f"| migration stall p50 / p99 | "
+            f"{fmt(ms.get('p50_ms'), ' ms')} / "
+            f"{fmt(ms.get('p99_ms'), ' ms')} |",
+            f"| hedged pulls (won) | {e['hedged_pulls']} "
+            f"({e['hedges_won']}) |",
+            f"| shard replacements | {e['shard_replacements']} |",
+        ]
     extra = report.get("extra")
     if extra:
         lines += ["", "## Extra", ""]

@@ -19,7 +19,10 @@ one of two override points:
     (the telemetry endpoint's one-shot HTTP-or-bare-line answer).
 
 Lifecycle: ``start()`` is idempotent, ``stop()`` closes the listener
-and every tracked connection and joins the accept thread; the context
+and every tracked connection and joins the accept thread AND the
+per-connection handler threads (with a timeout) — repeated
+start/stop cycles in one process (the elastic scale-in/out path) must
+not leak a thread per connection ever accepted; the context
 manager form pairs them.  ``port=0`` binds an ephemeral port — read it
 back from ``.port`` (the test/fixture pattern every front end uses).
 """
@@ -58,6 +61,7 @@ class LineServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._conns: List[socket.socket] = []
+        self._handlers: List[threading.Thread] = []
         self._conns_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
@@ -80,13 +84,49 @@ class LineServer:
         with self._conns_lock:
             for c in self._conns:
                 try:
+                    # a handler blocked in recv() does not notice close()
+                    # alone on all platforms; shutdown() interrupts it
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
                     c.close()
                 except OSError:
                     pass
             self._conns.clear()
+            handlers = list(self._handlers)
+            self._handlers.clear()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
             self._accept_thread = None
+        # join the per-connection handler threads: a scale-in/out cycle
+        # that stops servers repeatedly in ONE process must not leak a
+        # thread (and its socket buffers) per connection ever accepted
+        for t in handlers:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+        # final sweep: a connection accepted concurrently with the
+        # clear above may have registered afterwards — its handler
+        # exits on the stop flag; close its socket, join it, prune
+        with self._conns_lock:
+            for c in self._conns:
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            late = list(self._handlers)
+        for t in late:
+            if t is not threading.current_thread():
+                t.join(timeout=5)
+        with self._conns_lock:
+            self._handlers = [
+                t for t in self._handlers if t.is_alive()
+            ]
 
     @property
     def running(self) -> bool:
@@ -117,9 +157,17 @@ class LineServer:
                 pass
             with self._conns_lock:
                 self._conns.append(conn)
-            threading.Thread(
-                target=self._handle_and_close, args=(conn,), daemon=True
-            ).start()
+                # prune finished handlers so the tracking list stays
+                # bounded by LIVE connections, not total ever accepted
+                self._handlers = [
+                    t for t in self._handlers if t.is_alive()
+                ]
+                t = threading.Thread(
+                    target=self._handle_and_close, args=(conn,),
+                    daemon=True,
+                )
+                self._handlers.append(t)
+            t.start()
 
     def _handle_and_close(self, conn: socket.socket) -> None:
         try:

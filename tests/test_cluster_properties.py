@@ -100,6 +100,48 @@ def test_hash_growth_moves_keys_only_to_new_shards(
         assert set(ids[after == s]) <= set(ids[before == s])
 
 
+@settings(max_examples=60, deadline=None)
+@given(
+    capacity=st.integers(min_value=16, max_value=2048),
+    n_old=st.integers(1, 8),
+    n_new=st.integers(1, 8),
+    seed=seeds,
+)
+def test_epoch_transition_partitions_every_key_exactly_once(
+    capacity, n_old, n_new, seed
+):
+    """THE migration-safety property, over any old→new map pair
+    (growth, shrink, or no-op): the planned moves are exactly the
+    ownership diff — every key appears in at most one move, a moved
+    key's (src, dst) agree with both maps, no key is lost — and after
+    the flip the new map still owns every key exactly once."""
+    from flink_parameter_server_tpu.elastic.migration import plan_moves
+
+    old = ConsistentHashPartitioner(capacity, n_old, seed=seed)
+    new = ConsistentHashPartitioner(capacity, n_new, seed=seed)
+    moves = plan_moves(old, new)
+    ids = np.arange(capacity)
+    before, after = old.shard_of(ids), new.shard_of(ids)
+    moved = (
+        np.concatenate([mv.ids for mv in moves])
+        if moves else np.empty(0, np.int64)
+    )
+    # no key in two moves (none owned twice during the handoff)
+    assert len(np.unique(moved)) == len(moved)
+    # the moves are EXACTLY the ownership diff (no key lost: every
+    # key either stays put or is in exactly one move)
+    assert np.array_equal(np.sort(moved), ids[before != after])
+    for mv in moves:
+        assert (before[mv.ids] == mv.src).all()
+        assert (after[mv.ids] == mv.dst).all()
+    # after the flip: the new map's owned sets partition the key space
+    owned_concat = np.concatenate(
+        [new.owned_ids(s) for s in range(n_new)]
+    )
+    assert len(owned_concat) == capacity
+    assert np.array_equal(np.sort(owned_concat), ids)
+
+
 @settings(max_examples=40, deadline=None)
 @given(
     capacity=st.integers(min_value=32, max_value=1024),

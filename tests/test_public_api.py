@@ -77,6 +77,15 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.data.streams": [
         "microbatches", "partitioned_microbatches", "sparse_feature_batches",
         "prefetch", "from_collection"],
+    "flink_parameter_server_tpu.cluster": [
+        "ClusterClient", "ClusterConfig", "ClusterDriver",
+        "ConsistentHashPartitioner", "RangePartitioner", "ParamShard",
+        "ShardServer", "StalenessClock", "StaleEpoch", "FrozenKeys"],
+    "flink_parameter_server_tpu.elastic": [
+        "ElasticClusterConfig", "ElasticClusterDriver",
+        "ElasticController", "ScalePolicy", "MembershipService",
+        "PartitionEpoch", "plan_moves", "execute_moves", "Hedger",
+        "HedgeBudget"],
     "flink_parameter_server_tpu.data.movielens": [
         "synthetic_ratings", "load_movielens"],
     "flink_parameter_server_tpu.data.text": [

@@ -37,7 +37,7 @@ from typing import Iterable, List, Tuple
 # the HealthMonitor heartbeat component (resilience/health.py SERVING).
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
-     "serving_dispatch"}
+     "serving_dispatch", "elastic"}
 )
 
 
