@@ -1,11 +1,15 @@
 """Telemetry-overhead benchmark: what does the unified plane cost?
 
 The acceptance bar for the telemetry PR (ISSUE 3) is quantitative:
-steps/sec with the registry + span tracer enabled must sit within 3%
-of disabled on the CPU microbench.  This harness measures exactly that
-A/B on the real driver loop — same logic, same store shapes, same
-stream; the ONLY difference is ``DriverConfig.telemetry`` — and folds
-the result into ``results/<platform>/run_report.{md,json}`` (the page
+steps/sec with the observability plane enabled must sit within 3% of
+disabled on the CPU microbench.  ISSUE 6 widened the plane, so the ON
+arm now carries ALL of it: the registry + span tracer
+(``DriverConfig.telemetry``), a hot-key sketch observing every
+microbatch's item ids on the ingest path (telemetry/hotkeys.py), and
+an SLO engine sampling the registry on its own poll thread
+(telemetry/slo.py).  The OFF arm runs none of it.  Same logic, same
+store shapes, same stream; the result folds into
+``results/<platform>/run_report.{md,json}`` (the page
 docs/perf_status.md says future bench deltas must cite).
 
 Methodology: interleaved reps (on, off, on, off, ...) so drift in the
@@ -38,12 +42,21 @@ if REPO not in sys.path:
 
 def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
              num_items: int, dim: int, seed: int) -> float:
-    """One driver run; returns steps/sec (dispatch loop only)."""
+    """One driver run; returns steps/sec (dispatch loop only).  With
+    ``telemetry`` on, the FULL observability plane rides along:
+    registry + spans (driver config), a hot-key sketch on the ingest
+    path, and a polling SLO engine."""
     from flink_parameter_server_tpu.core.store import ShardedParamStore
     from flink_parameter_server_tpu.data.streams import microbatches
     from flink_parameter_server_tpu.models.matrix_factorization import (
         OnlineMatrixFactorization,
         SGDUpdater,
+    )
+    from flink_parameter_server_tpu.telemetry.hotkeys import HotKeySketch
+    from flink_parameter_server_tpu.telemetry.slo import (
+        SLOEngine,
+        pull_latency_slo,
+        serving_latency_slo,
     )
     from flink_parameter_server_tpu.training.driver import (
         DriverConfig,
@@ -69,8 +82,29 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
         logic, store,
         config=DriverConfig(dump_model=False, telemetry=telemetry),
     )
+    stream = microbatches(data, batch, epochs=1)
+    slo_engine = None
+    if telemetry:
+        sketch = HotKeySketch(32)
+
+        def observed(batches):
+            # sketch cost lands INSIDE the measured window, on the
+            # ingest path — where the cluster shards pay it
+            for b in batches:
+                sketch.observe(b["item"])
+                yield b
+
+        stream = observed(stream)
+        slo_engine = SLOEngine(
+            [pull_latency_slo(), serving_latency_slo()],
+            windows=(1.0, 5.0), register_gauges=False,
+        ).start(interval_s=0.02)
     t0 = time.perf_counter()
-    driver.run(microbatches(data, batch, epochs=1))
+    try:
+        driver.run(stream)
+    finally:
+        if slo_engine is not None:
+            slo_engine.stop()
     dt = time.perf_counter() - t0
     return driver.step_idx / dt
 
@@ -141,8 +175,8 @@ def main() -> None:
         steps=args.steps, reps=args.reps, batch=args.batch
     )
     print(json.dumps({
-        "metric": "telemetry overhead (registry+spans on vs off, "
-                  "CPU driver microbench)",
+        "metric": "telemetry overhead (registry+spans+hot-key sketch"
+                  "+SLO engine on vs off, CPU driver microbench)",
         "value": r["overhead_pct"],
         "unit": "% slowdown (negative = within noise, faster)",
         "extra": r,

@@ -55,6 +55,7 @@ wins (pulls are idempotent; pushes are never hedged).
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
 import socket
@@ -66,8 +67,12 @@ import numpy as np
 
 from ..core.api import ParameterServerClient
 from ..ops.dedup import aggregate_deltas, coalesce_ids
+from ..telemetry.distributed import TraceContext, format_token, new_trace
+from ..telemetry.spans import gen_id
 from .partition import Partitioner
 from .shard import format_rows, parse_rows
+
+_NULL_CM = contextlib.nullcontext()
 
 
 class ShardConnection:
@@ -207,6 +212,10 @@ class ClusterClient(ParameterServerClient):
         hedge=None,
         retry_timeout: float = 30.0,
         retry_sleep_s: float = 0.002,
+        tracer=None,
+        flightrec=None,
+        storm_threshold: int = 25,
+        storm_window_s: float = 5.0,
     ):
         if membership is None:
             if addresses is None or partitioner is None:
@@ -253,6 +262,19 @@ class ClusterClient(ParameterServerClient):
         # per-batch idempotence token base: unique per client instance
         self._pid_base = f"{os.getpid():x}.{id(self):x}"
         self._pid_counter = itertools.count()
+        # distributed tracing (telemetry/distributed.py): with a tracer
+        # attached, each pull/push batch becomes one trace, each shard
+        # request a child span whose id rides the frame as t=<tr>:<sp>
+        self._tracer = tracer
+        # stale-epoch storms: retry rounds that keep failing to
+        # converge on a servable map trip the flight recorder once
+        self._flightrec = flightrec
+        if membership is not None:
+            from ..telemetry.flightrec import StormDetector
+
+            self._storm = StormDetector(storm_threshold, storm_window_s)
+        else:
+            self._storm = None
         # unified plane (component=cluster): the pull RTT histogram and
         # the live in-flight window gauge
         if registry is not False:
@@ -275,9 +297,18 @@ class ClusterClient(ParameterServerClient):
                 if membership is not None
                 else None
             )
+            self._c_storms = (
+                reg.counter(
+                    "elastic_stale_epoch_storms_total",
+                    component="elastic", **labels,
+                )
+                if membership is not None
+                else None
+            )
         else:
             self._h_rtt = None
             self._c_refresh = None
+            self._c_storms = None
 
     # -- observability ------------------------------------------------------
     def inflight(self) -> int:
@@ -337,10 +368,38 @@ class ClusterClient(ParameterServerClient):
                 f"{self.retry_timeout}s without converging on a "
                 f"servable map"
             )
+        if self._storm is not None and self._storm.note():
+            # many reject-driven retries inside the window: the flip is
+            # NOT converging — blackbox it before a timeout loses the
+            # evidence (one dump per storm, throttled recorder-side)
+            if self._c_storms is not None:
+                self._c_storms.inc()
+            rec = self._flightrec
+            if rec is None:
+                from ..telemetry.flightrec import get_recorder
+
+                rec = get_recorder()
+            if rec is not None:
+                rec.note(
+                    "stale_epoch_storm", epoch=self._epoch, what=what,
+                    retries=self.frames_retried,
+                )
+                rec.dump("stale_epoch_storm")
         if not self._refresh_membership():
             time.sleep(min(0.05, self.retry_sleep_s * (1 + attempt)))
 
     # -- the batch surface --------------------------------------------------
+    def _trace_root(self, name: str):
+        """``(ctx, span_cm)`` opening one distributed trace per logical
+        batch call — ``(None, nullcontext)`` when tracing is off."""
+        tr = self._tracer
+        if tr is None or not tr.enabled:
+            return None, _NULL_CM
+        ctx = new_trace()
+        return ctx, tr.span(
+            name, "cluster", trace_id=ctx.trace_id, span_id=ctx.span_id
+        )
+
     def pull_batch(
         self, ids, mask=None, *, dtype=np.float32
     ) -> np.ndarray:
@@ -355,31 +414,33 @@ class ClusterClient(ParameterServerClient):
         todo = unique
         deadline = time.monotonic() + self.retry_timeout
         attempt = 0
-        while todo.size:
-            by_shard = self._split(todo)
-            rejected: List[np.ndarray] = []
-            rej_lock = threading.Lock()
+        ctx, root_span = self._trace_root("pull_batch")
+        with root_span:
+            while todo.size:
+                by_shard = self._split(todo)
+                rejected: List[np.ndarray] = []
+                rej_lock = threading.Lock()
 
-            def do(s, sids):
-                try:
-                    rows = self._pull_shard(s, sids)
-                except _Rejected as r:
-                    with rej_lock:
-                        rejected.append(r.ids)
-                    return
-                flat[np.searchsorted(unique, sids)] = rows.reshape(
-                    len(sids), width
+                def do(s, sids):
+                    try:
+                        rows = self._pull_shard(s, sids, ctx)
+                    except _Rejected as r:
+                        with rej_lock:
+                            rejected.append(r.ids)
+                        return
+                    flat[np.searchsorted(unique, sids)] = rows.reshape(
+                        len(sids), width
+                    )
+
+                self._for_each_shard(by_shard, do)
+                todo = (
+                    np.concatenate(rejected) if rejected
+                    else np.empty(0, np.int64)
                 )
-
-            self._for_each_shard(by_shard, do)
-            todo = (
-                np.concatenate(rejected) if rejected
-                else np.empty(0, np.int64)
-            )
-            if todo.size:
-                attempt += 1
-                self.frames_retried += 1
-                self._await_retry(deadline, attempt, "pull")
+                if todo.size:
+                    attempt += 1
+                    self.frames_retried += 1
+                    self._await_retry(deadline, attempt, "pull")
         out = flat.reshape(unique.shape + self.value_shape)
         return out[inverse]
 
@@ -406,33 +467,35 @@ class ClusterClient(ParameterServerClient):
         todo_ids, todo_rows = unique, summed
         deadline = time.monotonic() + self.retry_timeout
         attempt = 0
-        while todo_ids.size:
-            by_shard = self._split(todo_ids)
-            rejected: List[np.ndarray] = []
-            rej_lock = threading.Lock()
+        ctx, root_span = self._trace_root("push_batch")
+        with root_span:
+            while todo_ids.size:
+                by_shard = self._split(todo_ids)
+                rejected: List[np.ndarray] = []
+                rej_lock = threading.Lock()
 
-            def do(s, sids):
-                rows = todo_rows[np.searchsorted(todo_ids, sids)]
-                try:
-                    self._push_shard(s, sids, rows, pid)
-                except _Rejected as r:
-                    with rej_lock:
-                        rejected.append(r.ids)
+                def do(s, sids):
+                    rows = todo_rows[np.searchsorted(todo_ids, sids)]
+                    try:
+                        self._push_shard(s, sids, rows, pid, ctx)
+                    except _Rejected as r:
+                        with rej_lock:
+                            rejected.append(r.ids)
 
-            self._for_each_shard(by_shard, do)
-            done = todo_ids.size - sum(len(r) for r in rejected)
-            self.rows_pushed += int(done)
-            if rejected:
-                retry = np.sort(np.concatenate(rejected))
-                # keep the sorted-ids invariant: the per-shard row
-                # lookup above is a searchsorted against todo_ids
-                todo_rows = todo_rows[np.searchsorted(todo_ids, retry)]
-                todo_ids = retry
-                attempt += 1
-                self.frames_retried += 1
-                self._await_retry(deadline, attempt, "push")
-            else:
-                todo_ids = np.empty(0, np.int64)
+                self._for_each_shard(by_shard, do)
+                done = todo_ids.size - sum(len(r) for r in rejected)
+                self.rows_pushed += int(done)
+                if rejected:
+                    retry = np.sort(np.concatenate(rejected))
+                    # keep the sorted-ids invariant: the per-shard row
+                    # lookup above is a searchsorted against todo_ids
+                    todo_rows = todo_rows[np.searchsorted(todo_ids, retry)]
+                    todo_ids = retry
+                    attempt += 1
+                    self.frames_retried += 1
+                    self._await_retry(deadline, attempt, "push")
+                else:
+                    todo_ids = np.empty(0, np.int64)
         return int(unique.size)
 
     def flush(self) -> List[str]:
@@ -536,9 +599,22 @@ class ClusterClient(ParameterServerClient):
             suffix += f" e={self._epoch}"
         return suffix
 
+    def _frame_trace(self, shard: int, name: str, ctx):
+        """Per-shard child span + the wire token its id rides on:
+        ``(token_suffix, span_cm, span_id)`` — empties when untraced."""
+        if ctx is None or self._tracer is None or not self._tracer.enabled:
+            return "", _NULL_CM, None
+        span_id = gen_id(4)
+        tok = " " + format_token(TraceContext(ctx.trace_id, span_id))
+        cm = self._tracer.span(
+            f"{name}.shard{shard}", "cluster",
+            trace_id=ctx.trace_id, parent_id=ctx.span_id, span_id=span_id,
+        )
+        return tok, cm, span_id
+
     def _request_frames(
         self, shard: int, sids: np.ndarray, lines: List[str], *,
-        hedgeable: bool,
+        hedgeable: bool, trace=None,
     ) -> List[str]:
         """Send one shard's frames; a connection-level failure in
         elastic mode becomes a :class:`_Rejected` (drop the cached
@@ -567,6 +643,7 @@ class ClusterClient(ParameterServerClient):
                     ),
                     lines,
                     on_backup_won,
+                    trace=trace,
                 )
             return conn.request_many(lines)
         except OSError:
@@ -575,19 +652,29 @@ class ClusterClient(ParameterServerClient):
             self._drop_conn(shard)
             raise _Rejected(sids) from None
 
-    def _pull_shard(self, shard: int, ids: np.ndarray) -> np.ndarray:
+    def _pull_shard(
+        self, shard: int, ids: np.ndarray, ctx=None
+    ) -> np.ndarray:
         chunks = [
             ids[i: i + self.chunk] for i in range(0, len(ids), self.chunk)
         ]
-        suffix = self._frame_suffix()
+        tok, span_cm, span_id = self._frame_trace(shard, "pull", ctx)
+        suffix = self._frame_suffix() + tok
         lines = [
             "pull " + ",".join(str(int(i)) for i in c)
             + (" b64" if self.wire_format == "b64" else " text")
             + suffix
             for c in chunks
         ]
+        trace = (
+            (self._tracer, ctx.trace_id, span_id)
+            if span_id is not None else None
+        )
         t0 = time.perf_counter()
-        resps = self._request_frames(shard, ids, lines, hedgeable=True)
+        with span_cm:
+            resps = self._request_frames(
+                shard, ids, lines, hedgeable=True, trace=trace
+            )
         if self._h_rtt is not None:
             # one observation per chunk frame: the pipelined per-frame
             # turnaround, amortised (total wall / frames)
@@ -625,8 +712,10 @@ class ClusterClient(ParameterServerClient):
         ids: np.ndarray,
         deltas: np.ndarray,
         pid: Optional[str] = None,
+        ctx=None,
     ) -> None:
-        suffix = self._frame_suffix(pid)
+        tok, span_cm, _span_id = self._frame_trace(shard, "push", ctx)
+        suffix = self._frame_suffix(pid) + tok
         lines = []
         chunks = []
         for i in range(0, len(ids), self.chunk):
@@ -640,7 +729,8 @@ class ClusterClient(ParameterServerClient):
                 + format_rows(c_del, self.wire_format)
                 + suffix
             )
-        resps = self._request_frames(shard, ids, lines, hedgeable=False)
+        with span_cm:
+            resps = self._request_frames(shard, ids, lines, hedgeable=False)
         rejected: List[np.ndarray] = []
         for resp, c_ids in zip(resps, chunks):
             if _is_reject(resp) and self.membership is not None:

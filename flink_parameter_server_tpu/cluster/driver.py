@@ -78,6 +78,16 @@ class ClusterConfig:
     supervised: bool = True  # ShardServer restart supervision
     host: str = "127.0.0.1"
     request_timeout: float = 30.0
+    # distributed tracing (telemetry/distributed.py): one SpanTracer
+    # ring per shard server + one for the clients, pull/push frames
+    # stamped with t=<trace>:<span> tokens; collect the rings with
+    # driver.trace_rings() and merge via TraceCollector
+    trace: bool = False
+    # hot-key analytics (telemetry/hotkeys.py): per-shard count-min +
+    # space-saving sketches over pull/push key traffic, merged across
+    # shards on /metrics and in run_report
+    hot_keys: bool = False
+    hot_key_k: int = 32
 
 
 @dataclasses.dataclass
@@ -152,6 +162,10 @@ class ClusterDriver:
         self._clients: List[ClusterClient] = []
         self._started = False
         self._step_fn = None
+        # observability plumbing (both off by default — zero overhead)
+        self.client_tracer = None
+        self.shard_tracers: List = []
+        self._hotkey_labels: List[str] = []
 
     # -- lifecycle ---------------------------------------------------------
     def _wal_dir_for(self, shard_id: int) -> Optional[str]:
@@ -167,6 +181,22 @@ class ClusterDriver:
         """One shard + its TCP front end (the elastic driver reuses
         this for scale-out spin-up and dead-shard replacement)."""
         cfg = self.config
+        hotkeys = None
+        if cfg.hot_keys:
+            from ..telemetry.hotkeys import HotKeySketch, get_aggregator
+
+            hotkeys = HotKeySketch(cfg.hot_key_k)
+            label = f"shard-{shard_id}"
+            # re-registering (shard replacement) starts a fresh window
+            get_aggregator().register(label, hotkeys)
+            if label not in self._hotkey_labels:
+                self._hotkey_labels.append(label)
+        tracer = None
+        if cfg.trace:
+            from ..telemetry.spans import SpanTracer
+
+            tracer = SpanTracer(process=f"shard-{shard_id}")
+            self.shard_tracers.append(tracer)
         shard = ParamShard(
             shard_id,
             partitioner if partitioner is not None else self.partitioner,
@@ -174,9 +204,10 @@ class ClusterDriver:
             init_fn=self._init_fn,
             wal_dir=self._wal_dir_for(shard_id),
             registry=self.registry if self.registry is not None else False,
+            hotkeys=hotkeys,
         )
         server = ShardServer(
-            shard, cfg.host, 0, supervised=cfg.supervised
+            shard, cfg.host, 0, supervised=cfg.supervised, tracer=tracer
         ).start()
         return shard, server
 
@@ -188,6 +219,10 @@ class ClusterDriver:
         if self._started:
             return self
         cfg = self.config
+        if cfg.trace and self.client_tracer is None:
+            from ..telemetry.spans import SpanTracer
+
+            self.client_tracer = SpanTracer(process="client")
         for s in range(cfg.num_shards):
             shard, server = self._build_shard(s)
             self.shards.append(shard)
@@ -220,7 +255,18 @@ class ClusterDriver:
             wire_format=cfg.wire_format,
             registry=self.registry if self.registry is not None else False,
             worker=worker,
+            tracer=self.client_tracer,
         )
+
+    def trace_rings(self) -> List:
+        """Every per-process span ring this topology records into
+        (client first, then shards) — feed them to a
+        :class:`~..telemetry.distributed.TraceCollector`."""
+        rings = []
+        if self.client_tracer is not None:
+            rings.append(self.client_tracer)
+        rings.extend(self.shard_tracers)
+        return rings
 
     def stop(self) -> None:
         for c in self._clients:
@@ -233,6 +279,13 @@ class ClusterDriver:
         self.servers = []
         self.shards = []
         self._started = False
+        if self._hotkey_labels:
+            from ..telemetry.hotkeys import get_aggregator
+
+            agg = get_aggregator()
+            for label in self._hotkey_labels:
+                agg.unregister(label)
+            self._hotkey_labels = []
 
     def __enter__(self) -> "ClusterDriver":
         return self.start()

@@ -10,13 +10,16 @@ serving plane (``serving/server.py``) and the ingest edge
 
 Wire protocol (one request line → one response line, in order, per
 connection).  Every verb accepts trailing ``key=value`` options;
-``e=<epoch>`` tags the frame with the client's partition-map epoch and
+``e=<epoch>`` tags the frame with the client's partition-map epoch,
 ``pid=<token>`` makes a push idempotent (exactly-once across retries —
-see below)::
+see below), and ``t=<trace>:<span>`` carries the distributed-trace
+context (telemetry/distributed.py; servers without a tracer — and
+PR-5-era servers — parse and ignore it, the protocol-versioning
+contract for observability options)::
 
-    pull <id1,id2,...> [text|b64] [e=<n>]    # global ids + answer format
-    push <id1,id2,...> <payload> [pid=<t>] [e=<n>]  # deltas, 1 row/id
-    xfer <id1,id2,...>                       # atomic (rows, seq) snapshot
+    pull <id1,id2,...> [text|b64] [e=<n>] [t=<tok>]  # ids + answer format
+    push <id1,id2,...> <payload> [pid=<t>] [e=<n>] [t=<tok>]  # deltas
+    xfer <id1,id2,...> [t=<tok>]             # atomic (rows, seq) snapshot
     load <id1,id2,...> <payload>             # row ASSIGNMENT (migration)
     flush                                    # fsync the WAL, ack counters
     stats                                    # one-line JSON shard stats
@@ -197,6 +200,7 @@ class ParamShard:
         wal_dir: Optional[str] = None,
         wal_fsync_every: int = 0,
         registry=None,
+        hotkeys=None,
     ):
         self.shard_id = int(shard_id)
         self.partitioner = partitioner
@@ -214,6 +218,10 @@ class ParamShard:
             # chaos mode tests exercise; page-cache durability suffices
             # and per-push fsyncs would dominate small-push latency
             self._wal = UpdateWAL(wal_dir, fsync_every=wal_fsync_every)
+        # hot-key analytics (telemetry/hotkeys.py): with a sketch
+        # attached, every pulled/pushed id batch is observed — the
+        # Zipf-skew measurement gating the serving hot-key tier
+        self.hotkeys = hotkeys
         self.pushes_applied = 0
         self.pulls_served = 0
         self.restarts = 0
@@ -440,11 +448,14 @@ class ParamShard:
     ) -> np.ndarray:
         with self._lock:
             self._check_alive()
-            local = self._route(np.asarray(global_ids, np.int64), epoch)
+            ids = np.asarray(global_ids, np.int64)
+            local = self._route(ids, epoch)
             if self._host_mirror is None:
                 self._host_mirror = np.asarray(self.store.values())
             vals = self._host_mirror[local]
             self.pulls_served += 1
+            if self.hotkeys is not None:
+                self.hotkeys.observe(ids)
             if self._c_pulls is not None:
                 self._c_pulls.inc()
             return vals
@@ -479,6 +490,8 @@ class ParamShard:
             # BEFORE it is logged (replaying a bad frame would re-raise
             # forever)
             self._route(ids, epoch)
+            if self.hotkeys is not None:
+                self.hotkeys.observe(ids)
             if pid is not None:
                 fresh = np.asarray(
                     [(pid, int(g)) not in self._applied_pairs for g in ids]
@@ -769,6 +782,7 @@ class ShardServer(LineServer):
         supervised: bool = True,
         restart_policy=None,
         max_line_bytes: int = 64 << 20,
+        tracer=None,
     ):
         super().__init__(
             host, port, name=f"shard-{shard.shard_id}",
@@ -776,6 +790,11 @@ class ShardServer(LineServer):
         )
         self.shard = shard
         self.supervised = supervised
+        # server-side spans (telemetry/distributed.py): each request is
+        # wrapped in a span tagged with the inbound t=<trace>:<span>
+        # context, so this process's ring can be merged into the
+        # client's trace by the TraceCollector
+        self.tracer = tracer
         if restart_policy is None:
             from ..resilience.recovery import RestartPolicy
 
@@ -837,7 +856,38 @@ class ShardServer(LineServer):
                 raise ValueError(f"e={epoch!r}: epoch must be an integer")
         return opts
 
+    @staticmethod
+    def _inbound_trace(toks):
+        """The ``t=<trace>:<span>`` token from a frame's trailing
+        options (scanned from the end; payload tokens — which may
+        contain base64 ``=`` padding behind their ``b64:`` prefix —
+        stop the scan).  Malformed tokens yield None, never an error:
+        tracing must not be able to fail a request."""
+        from ..telemetry.distributed import parse_token
+
+        for t in reversed(toks[1:]):
+            k, sep, v = t.partition("=")
+            if not sep or not k.isalnum():
+                break
+            if k == "t":
+                return parse_token(v)
+        return None
+
     def _dispatch(self, line: str) -> str:
+        tr = self.tracer
+        if tr is None or not tr.enabled:
+            return self._execute(line)
+        toks = line.split()
+        cmd = toks[0].lower() if toks else "empty"
+        ctx = self._inbound_trace(toks)
+        kwargs = (
+            {"trace_id": ctx.trace_id, "parent_id": ctx.span_id}
+            if ctx is not None else {}
+        )
+        with tr.span(f"shard.{cmd}", "cluster", **kwargs):
+            return self._execute(line)
+
+    def _execute(self, line: str) -> str:
         toks = line.split()
         cmd = toks[0].lower()
         if cmd == "pull":
@@ -874,9 +924,10 @@ class ShardServer(LineServer):
             )
             return f"ok applied={len(ids)} seq={seq}"
         if cmd == "xfer":
-            if len(toks) != 2:
-                raise ValueError("usage: xfer <id1,id2,...>")
+            if len(toks) < 2:
+                raise ValueError("usage: xfer <id1,id2,...> [t=<token>]")
             ids = parse_ids(toks[1])
+            self._parse_opts(toks[2:])  # trace token etc.; validated only
             vals, seq = self.shard.snapshot_rows(ids)
             return f"ok n={len(ids)} seq={seq} {format_rows(vals, 'b64')}"
         if cmd == "load":

@@ -36,6 +36,7 @@ import numpy as np
 from ..cluster.client import ClusterClient
 from ..cluster.driver import ClusterConfig, ClusterDriver
 from ..cluster.partition import ConsistentHashPartitioner
+from ..telemetry.flightrec import get_recorder
 from .hedging import HedgeBudget, Hedger
 from .membership import MembershipService
 from .migration import MigrationReport, execute_moves, plan_moves
@@ -139,6 +140,7 @@ class ElasticClusterDriver(ClusterDriver):
             membership=self.membership,
             hedge=hedge,
             retry_timeout=getattr(cfg, "retry_timeout", 30.0),
+            tracer=self.client_tracer,
         )
 
     def stop(self) -> None:
@@ -165,6 +167,9 @@ class ElasticClusterDriver(ClusterDriver):
         errors until :meth:`replace_shard` publishes a successor)."""
         self.servers[shard_id].stop()
         self.shards[shard_id].crash()
+        rec = get_recorder()
+        if rec is not None:
+            rec.note("shard_kill", shard=shard_id)
 
     def _addresses(self) -> List[Tuple[str, int]]:
         return [(srv.host, srv.port) for srv in self.servers]
@@ -262,6 +267,7 @@ class ElasticClusterDriver(ClusterDriver):
             chunk=cfg.chunk,
             verify=getattr(cfg, "verify_migrations", True),
             registry=self.registry,
+            tracer=self.client_tracer,
         )
         epoch = self.membership.current().epoch + 1
         for sh in shards:
@@ -281,6 +287,14 @@ class ElasticClusterDriver(ClusterDriver):
             if self._h_stall is not None:
                 self._h_stall.observe(now - t0)
         self.resize_reports.append(report)
+        rec = get_recorder()
+        if rec is not None:
+            rec.note(
+                "epoch_flip", epoch=epoch,
+                num_shards=new_part.num_shards,
+                rows_moved=report.rows_moved,
+                tail_rows=report.tail_rows,
+            )
         return report
 
     def replace_shard(self, shard_id: int) -> int:
@@ -313,6 +327,12 @@ class ElasticClusterDriver(ClusterDriver):
             self.membership.publish(self.partitioner, self._addresses())
             if self._c_replacements is not None:
                 self._c_replacements.inc()
+            rec = get_recorder()
+            if rec is not None:
+                rec.note(
+                    "shard_replace", shard=shard_id, replayed=replayed,
+                    epoch=self.membership.current().epoch,
+                )
             return replayed
 
     @staticmethod
@@ -379,9 +399,14 @@ class ElasticController:
         policy: Optional[ScalePolicy] = None,
         registry=None,
         interval_s: float = 0.5,
+        slo=None,
     ):
         self.driver = driver
         self.policy = policy if policy is not None else ScalePolicy()
+        # optional SLO engine (telemetry/slo.py): a breached objective
+        # is a scale-out pressure signal alongside the raw thresholds —
+        # the declarative form of the same policy
+        self.slo = slo
         self.registry = (
             registry if registry is not None else driver.registry
         )
@@ -452,6 +477,10 @@ class ElasticController:
         p99, frames = self._windowed_rtt_p99()
         depth = self._max_queue_depth()
         staleness = self._staleness()
+        slo_breaches: List[str] = []
+        if self.slo is not None:
+            self.slo.sample()
+            slo_breaches = self.slo.breached()
         decision: Optional[dict] = None
         pressured = (
             (
@@ -465,11 +494,13 @@ class ElasticController:
                 and staleness is not None
                 and staleness > pol.scale_out_staleness
             )
+            or bool(slo_breaches)
         )
         if pressured and n < pol.max_shards:
             decision = {
                 "action": "scale_out", "p99_s": p99, "depth": depth,
                 "staleness": staleness, "frames": frames,
+                "slo_breaches": slo_breaches,
             }
         elif (
             p99 is not None

@@ -38,8 +38,11 @@ Three safety properties, in order of importance:
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_NULL_CM = contextlib.nullcontext()
 
 
 class HedgeBudget:
@@ -165,6 +168,8 @@ class Hedger:
         spare_factory: Callable,
         lines: Sequence[str],
         on_backup_won: Optional[Callable] = None,
+        *,
+        trace=None,
     ) -> List[str]:
         """``conn.request_many(lines)``, hedged.  If the primary is
         still silent after ``after_s`` and the budget allows, the same
@@ -172,7 +177,13 @@ class Hedger:
         set wins.  When the backup wins, ``on_backup_won(spare_conn)``
         hands the clean spare to the caller, which MUST stop using (and
         close) the still-draining primary — a line-protocol connection
-        has one reader."""
+        has one reader.
+
+        ``trace`` is an optional ``(tracer, trace_id, parent_id)``
+        triple: each racer is then recorded as a ``hedge.primary`` /
+        ``hedge.backup`` span under the caller's shard-request span, so
+        a merged trace (telemetry/distributed.py) shows the two legs
+        racing."""
         self.budget.note_requests(len(lines))
         done = threading.Event()
         state: dict = {}
@@ -180,7 +191,16 @@ class Hedger:
 
         def race(tag: str, c) -> None:
             try:
-                resps = c.request_many(list(lines))
+                if trace is not None:
+                    tracer, trace_id, parent_id = trace
+                    span_cm = tracer.span(
+                        f"hedge.{tag}", "elastic",
+                        trace_id=trace_id, parent_id=parent_id,
+                    )
+                else:
+                    span_cm = _NULL_CM
+                with span_cm:
+                    resps = c.request_many(list(lines))
                 with lock:
                     state.setdefault("winner", (tag, resps))
             except BaseException as e:  # noqa: BLE001 — surfaced below

@@ -52,6 +52,8 @@ import numpy as np
 from ..cluster.client import ShardConnection, _check_ok
 from ..cluster.partition import Partitioner
 from ..cluster.shard import ParamShard, format_rows, parse_rows
+from ..telemetry.distributed import TraceContext, format_token, new_trace
+from ..telemetry.spans import gen_id
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +114,7 @@ def _xfer_rows(
     ids: np.ndarray,
     value_shape: Tuple[int, ...],
     chunk: int,
+    tok: str = "",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Pull ``(rows, per_id_snapshot_seq)`` over the wire.  Each chunk
     is one atomic ``xfer``; its seq stamps every id in it, so the tail
@@ -121,7 +124,7 @@ def _xfer_rows(
     seqs = np.empty(len(ids), np.int64)
     chunks = [ids[i: i + chunk] for i in range(0, len(ids), chunk)]
     lines = [
-        "xfer " + ",".join(str(int(x)) for x in c) for c in chunks
+        "xfer " + ",".join(str(int(x)) for x in c) + tok for c in chunks
     ]
     pos = 0
     for resp, c in zip(conn.request_many(lines), chunks):
@@ -144,6 +147,7 @@ def _load_rows(
     ids: np.ndarray,
     rows: np.ndarray,
     chunk: int,
+    tok: str = "",
 ) -> None:
     chunks = range(0, len(ids), chunk)
     lines = [
@@ -151,6 +155,7 @@ def _load_rows(
         + ",".join(str(int(x)) for x in ids[i: i + chunk])
         + " "
         + format_rows(rows[i: i + chunk], "b64")
+        + tok
         for i in chunks
     ]
     for resp in conn.request_many(lines):
@@ -166,14 +171,38 @@ def execute_moves(
     chunk: int = 1024,
     verify: bool = True,
     registry=None,
+    tracer=None,
 ) -> MigrationReport:
     """Run the migration protocol for every move; the caller flips the
     epoch afterwards (sources stay frozen until then).  ``shards_by_id``
     holds in-process handles (WAL tail + pid handoff + freeze are
     control-plane local); bulk rows move over the wire via
-    ``addr_by_id``."""
+    ``addr_by_id``.  With a ``tracer``, the whole migration becomes one
+    distributed trace: per-move ``migrate.move`` spans on the control
+    plane, and every ``xfer``/``load`` frame stamped with a
+    ``t=<trace>:<span>`` token so the involved shards' server spans
+    stitch into the same story."""
     value_shape = tuple(int(s) for s in value_shape)
     report = MigrationReport(moves=len(moves))
+    ctx = root_cm = None
+    if tracer is not None and tracer.enabled:
+        ctx = new_trace()
+        root_cm = tracer.span(
+            "migrate", "elastic",
+            trace_id=ctx.trace_id, span_id=ctx.span_id,
+        )
+        root_cm.__enter__()
+
+    def _move_trace(src: int, dst: int):
+        """(token, span_cm) for one move's wire frames."""
+        if ctx is None:
+            return "", None
+        span_id = gen_id(4)
+        tok = " " + format_token(TraceContext(ctx.trace_id, span_id))
+        return tok, tracer.span(
+            f"migrate.move.{src}-{dst}", "elastic",
+            trace_id=ctx.trace_id, parent_id=ctx.span_id, span_id=span_id,
+        )
     if registry is not False and registry is not None:
         c_rows = registry.counter(
             "elastic_rows_migrated_total", component="elastic"
@@ -207,10 +236,17 @@ def execute_moves(
                 report.freeze_started[src] = time.monotonic()
             snap: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
             for mv in src_moves:
-                rows, seqs = _xfer_rows(
-                    conn(src), mv.ids, value_shape, chunk
-                )
-                _load_rows(conn(mv.dst), mv.ids, rows, chunk)
+                tok, move_cm = _move_trace(mv.src, mv.dst)
+                if move_cm is not None:
+                    move_cm.__enter__()
+                try:
+                    rows, seqs = _xfer_rows(
+                        conn(src), mv.ids, value_shape, chunk, tok
+                    )
+                    _load_rows(conn(mv.dst), mv.ids, rows, chunk, tok)
+                finally:
+                    if move_cm is not None:
+                        move_cm.__exit__(None, None, None)
                 snap[mv.dst] = (mv.ids, rows, seqs)
                 report.rows_moved += int(len(mv.ids))
                 if c_rows is not None:
@@ -294,6 +330,8 @@ def execute_moves(
     finally:
         for c in conns.values():
             c.close()
+        if root_cm is not None:
+            root_cm.__exit__(None, None, None)
     return report
 
 

@@ -122,6 +122,7 @@ class StallWatchdog:
         poll_s: Optional[float] = None,
         sink=None,
         registry=None,
+        flightrec=None,
     ):
         if stall_after_s <= 0:
             raise ValueError(f"stall_after_s={stall_after_s}: must be > 0")
@@ -132,6 +133,11 @@ class StallWatchdog:
             float(poll_s) if poll_s is not None else self.stall_after_s / 4
         )
         self.sink = sink
+        # flight recorder (telemetry/flightrec.py): each stall episode
+        # dumps the blackbox — a wedged process's post-mortem must not
+        # depend on a live scrape.  None = the process-wide recorder
+        # (no-op when none installed); False = never dump.
+        self._flightrec = flightrec
         # unified plane: each stall episode also bumps
         # stall_episodes_total{component=<stalled>} (registry=False
         # opts out; None = the process-wide default)
@@ -196,6 +202,15 @@ class StallWatchdog:
                 reg.counter(
                     "stall_episodes_total", component=event["stall"]
                 ).inc()
+            if self._flightrec is not False:
+                rec = self._flightrec
+                if rec is None:
+                    from ..telemetry.flightrec import get_recorder
+
+                    rec = get_recorder()
+                if rec is not None:
+                    rec.note("stall", **event)
+                    rec.dump(f"stall_{event['stall']}")
             if self.sink is not None:
                 # one-JSON-per-episode stays; the line now carries the
                 # shared ts/run_id like every other emitter

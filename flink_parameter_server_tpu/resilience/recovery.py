@@ -159,11 +159,16 @@ class RecoveringDriver:
         policy: Optional[RestartPolicy] = None,
         metrics_sink=None,
         registry=None,
+        flightrec=None,
     ):
         self.driver = driver
         self.data_factory = data_factory
         self.policy = policy if policy is not None else RestartPolicy()
         self.metrics_sink = metrics_sink
+        # flight recorder: blackbox-dump on every crash BEFORE the
+        # restart overwrites the evidence (None = process-wide
+        # recorder, no-op when none installed; False = never)
+        self._flightrec = flightrec
         self.events: List[dict] = []
         self.restarts = 0
         self.steps_replayed = 0
@@ -216,12 +221,28 @@ class RecoveringDriver:
                     ) from exc
                 backoff = self.policy.backoff_s(attempt, self._rng)
                 event["backoff_s"] = round(backoff, 4)
+                if self._flightrec is not False:
+                    rec = self._flightrec
+                    if rec is None:
+                        from ..telemetry.flightrec import get_recorder
+
+                        rec = get_recorder()
+                    if rec is not None:
+                        rec.note(
+                            "crash", failure=fc.value, restart=attempt,
+                            error=event["error"],
+                        )
+                        rec.dump(f"crash_{fc.value}")
                 tracer = get_tracer()
                 if backoff > 0:
                     with tracer.span("backoff", component="recovery"):
                         time.sleep(backoff)
+                t_rec = time.monotonic()
                 with tracer.span("recover", component="recovery"):
                     self._recover(fc, exc, event)
+                self._registry.histogram(
+                    "recovery_duration_seconds", component="recovery"
+                ).observe(time.monotonic() - t_rec)
                 self.restarts += 1
                 self._record(event)
 

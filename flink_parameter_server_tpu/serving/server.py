@@ -75,9 +75,15 @@ class ServingService:
         batcher: Optional[RequestBatcher] = None,
         metrics: Optional[ServingMetrics] = None,
         registry=None,
+        hotkeys=None,
     ):
         self.engine = engine
         self.snapshots = engine.snapshots
+        # hot-key analytics (telemetry/hotkeys.py): with a sketch
+        # attached, every served lookup's requested ids are observed —
+        # the serving-side half of the Zipf-skew measurement (register
+        # the sketch with the aggregator to fold it into /metrics)
+        self.hotkeys = hotkeys
         self.batcher = batcher if batcher is not None else RequestBatcher()
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.metrics.queue_depth_fn = lambda: self.batcher.depth
@@ -293,6 +299,10 @@ class ServingService:
         ids = np.zeros((bucket, w_pad), np.int32)
         for i, p in enumerate(pending):
             ids[i, : len(p.payload.ids)] = p.payload.ids
+        if self.hotkeys is not None:
+            self.hotkeys.observe(np.concatenate([
+                np.asarray(p.payload.ids, np.int64) for p in pending
+            ]))
         try:
             res = self.engine.lookup(ids)
         except Exception as e:

@@ -13,8 +13,35 @@ through, and the seam every later perf PR is judged through:
   * :mod:`.exporter` — Prometheus-text rendering + the TCP
     ``/metrics`` / ``/healthz`` endpoint (live during training).
   * :mod:`.report` — ``results/<platform>/run_report.{md,json}``.
+  * :mod:`.distributed` — cross-process trace propagation
+    (``t=<trace>:<span>`` wire tokens) + the clock-aligning
+    :class:`TraceCollector` that merges per-process rings into one
+    Chrome/Perfetto trace.
+  * :mod:`.hotkeys` — count-min + space-saving hot-key sketches over
+    pull/push/serving key traffic, merged across shards.
+  * :mod:`.flightrec` — the bounded blackbox ring dumped to
+    ``results/<platform>/flightrec_<reason>.json`` on crash, stall,
+    or stale-epoch storm.
+  * :mod:`.slo` — declarative objectives evaluated as multi-window
+    burn rates, consumable by the elastic controller.
 """
+from .distributed import (
+    TraceCollector,
+    TraceContext,
+    format_token,
+    new_trace,
+    parse_token,
+)
 from .exporter import TelemetryServer, prometheus_text, scrape
+from .flightrec import FlightRecorder, StormDetector, get_recorder, set_recorder
+from .hotkeys import (
+    HotKeyAggregator,
+    HotKeySketch,
+    SpaceSavingTopK,
+    get_aggregator,
+    set_aggregator,
+)
+from .slo import SLOEngine, SLOSpec, default_slos
 from .registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -49,4 +76,21 @@ __all__ = [
     "build_run_report",
     "render_markdown",
     "write_run_report",
+    "TraceCollector",
+    "TraceContext",
+    "format_token",
+    "new_trace",
+    "parse_token",
+    "FlightRecorder",
+    "StormDetector",
+    "get_recorder",
+    "set_recorder",
+    "HotKeyAggregator",
+    "HotKeySketch",
+    "SpaceSavingTopK",
+    "get_aggregator",
+    "set_aggregator",
+    "SLOEngine",
+    "SLOSpec",
+    "default_slos",
 ]

@@ -54,12 +54,20 @@ def _fmt_value(v) -> str:
     return repr(f)
 
 
-def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+def prometheus_text(
+    registry: Optional[MetricsRegistry] = None,
+    *,
+    collectors=None,
+    include_hot_keys: bool = True,
+) -> str:
     """Render the registry in Prometheus exposition format (0.0.4).
 
     Counters get the conventional ``_total`` suffix (unless already
     named that way); histograms expand to cumulative ``_bucket{le=}``
-    series plus ``_sum``/``_count``."""
+    series plus ``_sum``/``_count``.  The merged hot-key sketch
+    (telemetry/hotkeys.py) is appended as ``fps_hot_key_traffic``
+    gauge lines whenever any sketch is registered; ``collectors`` are
+    extra zero-arg callables returning exposition lines."""
     reg = registry if registry is not None else get_registry()
     by_name: dict = {}
     for inst in reg.instruments():
@@ -101,6 +109,17 @@ def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
                     f"{out_name}{_fmt_labels(inst.labels)} "
                     f"{_fmt_value(inst.value)}"
                 )
+    if include_hot_keys:
+        from .hotkeys import get_aggregator
+
+        agg = get_aggregator()
+        if agg.labels():
+            lines.extend(agg.exposition(prefix=_PREFIX))
+    for coll in collectors or ():
+        try:
+            lines.extend(coll())
+        except Exception:  # a broken collector must not kill a scrape
+            pass
     return "\n".join(lines) + "\n"
 
 
@@ -129,12 +148,14 @@ class TelemetryServer(LineServer):
         health=None,
         stall_after_s: Optional[float] = None,
         max_request_bytes: int = 8192,
+        collectors=None,
     ):
         super().__init__(host, port, name="telemetry")
         self.registry = registry if registry is not None else get_registry()
         self.health = health
         self.stall_after_s = stall_after_s
         self.max_request_bytes = int(max_request_bytes)
+        self.collectors = list(collectors) if collectors else []
 
     def start(self) -> "TelemetryServer":
         super().start()
@@ -155,20 +176,33 @@ class TelemetryServer(LineServer):
             "utf-8", "replace"
         ).strip()
         http = first.upper().startswith(("GET ", "HEAD "))
+        head_only = first.upper().startswith("HEAD ")
         path = first.split()[1] if http and len(
             first.split()
         ) >= 2 else first
         path = path.strip().lstrip("/").lower() or "metrics"
         if path.startswith("metrics"):
-            body = prometheus_text(self.registry)
+            body = prometheus_text(
+                self.registry, collectors=self.collectors
+            )
+            # the Prometheus text exposition content type, verbatim —
+            # scrapers key the parser off version=0.0.4
             ctype = "text/plain; version=0.0.4; charset=utf-8"
             status = "200 OK"
         elif path.startswith("healthz"):
             body = json.dumps(self._healthz()) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("hotkeys"):
+            from .hotkeys import get_aggregator
+
+            body = json.dumps(
+                {"hot_keys": get_aggregator().snapshot()}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         else:
-            body = f"unknown path {path!r} (metrics|healthz)\n"
+            body = f"unknown path {path!r} (metrics|healthz|hotkeys)\n"
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"
         payload = body.encode("utf-8")
@@ -179,7 +213,9 @@ class TelemetryServer(LineServer):
                 f"Content-Length: {len(payload)}\r\n"
                 f"Connection: close\r\n\r\n"
             ).encode("ascii")
-            conn.sendall(head + payload)
+            # HEAD answers headers (with the GET body's exact
+            # Content-Length) and no body — RFC 9110 §9.3.2
+            conn.sendall(head if head_only else head + payload)
         else:
             conn.sendall(payload)
 

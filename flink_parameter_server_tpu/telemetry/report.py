@@ -134,11 +134,57 @@ def build_run_report(
             "shard_replacements": int(
                 _sum_counter(snap, "elastic_shard_replacements_total")
             ),
+            "stale_epoch_storms": int(
+                _sum_counter(snap, "elastic_stale_epoch_storms_total")
+            ),
         },
     }
+    hedged = report["elastic"]["hedged_pulls"]
+    report["elastic"]["hedge_win_rate"] = (
+        round(report["elastic"]["hedges_won"] / hedged, 4)
+        if hedged else None
+    )
+    slo = _slo_section(snap)
+    if slo:
+        report["slo"] = slo
+    hot = _hot_keys_section()
+    if hot is not None:
+        report["hot_keys"] = hot
     if extra:
         report["extra"] = dict(extra)
     return report
+
+
+def _slo_section(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-objective verdict roll-up from the SLO engine's probe
+    gauges (telemetry/slo.py) — empty when no engine is attached."""
+    out: Dict[str, Any] = {}
+    for s in snap.get("slo_healthy", ()):
+        name = s["labels"].get("slo")
+        if name is None:
+            continue
+        v = s["value"]
+        out[name] = {
+            "healthy": None if v is None else bool(v),
+        }
+    for s in snap.get("slo_burn_rate", ()):
+        name = s["labels"].get("slo")
+        window = s["labels"].get("window", "short")
+        if name is None:
+            continue
+        out.setdefault(name, {})[f"burn_{window}"] = s["value"]
+    return out
+
+
+def _hot_keys_section(n: int = 10) -> Optional[Dict[str, Any]]:
+    """Merged hot-key sketch snapshot (telemetry/hotkeys.py) — None
+    when no sketch is registered."""
+    from .hotkeys import get_aggregator
+
+    agg = get_aggregator()
+    if not agg.labels():
+        return None
+    return agg.snapshot(n)
 
 
 def _default_platform() -> str:
@@ -189,6 +235,7 @@ def render_markdown(report: Dict[str, Any]) -> str:
     ]
     if e:
         ms = e.get("migration_stall", {})
+        win = e.get("hedge_win_rate")
         lines += [
             f"| elastic epoch (flips / client refreshes) | "
             f"{fmt(e['epoch'])} ({e['epoch_flips']} / "
@@ -197,10 +244,39 @@ def render_markdown(report: Dict[str, Any]) -> str:
             f"| migration stall p50 / p99 | "
             f"{fmt(ms.get('p50_ms'), ' ms')} / "
             f"{fmt(ms.get('p99_ms'), ' ms')} |",
-            f"| hedged pulls (won) | {e['hedged_pulls']} "
-            f"({e['hedges_won']}) |",
+            f"| hedged pulls (won / win rate) | {e['hedged_pulls']} "
+            f"({e['hedges_won']} / {fmt(win)}) |",
             f"| shard replacements | {e['shard_replacements']} |",
+            f"| stale-epoch storms | {e.get('stale_epoch_storms', 0)} |",
         ]
+    slo = report.get("slo")
+    if slo:
+        lines += ["", "## SLO verdicts", ""]
+        lines += ["| objective | healthy | burn short / long |",
+                  "|---|---|---|"]
+        for name in sorted(slo):
+            v = slo[name]
+            healthy = v.get("healthy")
+            lines.append(
+                f"| {name} | "
+                f"{'—' if healthy is None else ('yes' if healthy else 'NO')}"
+                f" | {fmt(v.get('burn_short'))} / "
+                f"{fmt(v.get('burn_long'))} |"
+            )
+    hot = report.get("hot_keys")
+    if hot:
+        lines += ["", "## Hot keys", ""]
+        lines.append(
+            f"top keys over {hot['total_observed']} observed "
+            f"(count-min error bound ±{hot['cms_error_bound']}, "
+            f"sketches: {', '.join(hot['sketches'])}):"
+        )
+        lines.append("")
+        lines += ["| key | count | err |", "|---|---|---|"]
+        for item in hot["top"][:10]:
+            lines.append(
+                f"| {item['key']} | {item['count']} | {item['err']} |"
+            )
     extra = report.get("extra")
     if extra:
         lines += ["", "## Extra", ""]

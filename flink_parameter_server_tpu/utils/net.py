@@ -63,6 +63,15 @@ class LineServer:
         self._conns: List[socket.socket] = []
         self._handlers: List[threading.Thread] = []
         self._conns_lock = threading.Lock()
+        self.connections_accepted = 0  # lifetime count (observability)
+
+    def live_connections(self) -> int:
+        """Currently-open handler connections (the lifetime count is
+        :attr:`connections_accepted`) — the churn observability the
+        span-tracer leak regression test reads alongside
+        ``SpanTracer.stack_count()``."""
+        with self._conns_lock:
+            return len(self._conns)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "LineServer":
@@ -157,6 +166,7 @@ class LineServer:
                 pass
             with self._conns_lock:
                 self._conns.append(conn)
+                self.connections_accepted += 1
                 # prune finished handlers so the tracking list stays
                 # bounded by LIVE connections, not total ever accepted
                 self._handlers = [
@@ -165,6 +175,7 @@ class LineServer:
                 t = threading.Thread(
                     target=self._handle_and_close, args=(conn,),
                     daemon=True,
+                    name=f"{self.name}-conn-{self.connections_accepted}",
                 )
                 self._handlers.append(t)
             t.start()

@@ -48,6 +48,18 @@ MODULE_SYMBOLS = {
         "prometheus_text", "TelemetryServer", "scrape"],
     "flink_parameter_server_tpu.telemetry.report": [
         "build_run_report", "render_markdown", "write_run_report"],
+    "flink_parameter_server_tpu.telemetry.distributed": [
+        "TraceContext", "TraceCollector", "new_trace", "parse_token",
+        "format_token"],
+    "flink_parameter_server_tpu.telemetry.hotkeys": [
+        "CountMinSketch", "SpaceSavingTopK", "HotKeySketch",
+        "HotKeyAggregator", "get_aggregator", "set_aggregator"],
+    "flink_parameter_server_tpu.telemetry.flightrec": [
+        "FlightRecorder", "StormDetector", "get_recorder",
+        "set_recorder"],
+    "flink_parameter_server_tpu.telemetry.slo": [
+        "SLOEngine", "SLOSpec", "default_slos", "pull_latency_slo",
+        "serving_latency_slo", "staleness_slo", "recovery_time_slo"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",

@@ -1,0 +1,769 @@
+"""Cross-process tracing / hot-key analytics / SLO / flight-recorder
+tests (the ISSUE-6 observability plane, docs/observability.md).
+
+The acceptance anchor is the e2e: train-while-serve on a 2-shard
+elastic cluster with a chaos-injected straggler, rings collected from
+every process lane, and the merged Chrome trace showing ONE pull trace
+spanning ≥ 2 lanes with the hedged backup visible — plus the artifact
+lints (trace + flight recorder) that keep those files parseable.
+"""
+import http.client
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu import telemetry as tm
+from flink_parameter_server_tpu.cluster import (
+    ClusterConfig,
+    ClusterDriver,
+    ParamShard,
+    RangePartitioner,
+    ShardServer,
+)
+from flink_parameter_server_tpu.cluster.client import ClusterClient
+from flink_parameter_server_tpu.cluster.shard import format_rows
+from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+from flink_parameter_server_tpu.data.streams import microbatches
+from flink_parameter_server_tpu.elastic import (
+    ElasticClusterConfig,
+    ElasticClusterDriver,
+    ElasticController,
+    MembershipService,
+    ScalePolicy,
+)
+from flink_parameter_server_tpu.models.matrix_factorization import (
+    OnlineMatrixFactorization,
+    SGDUpdater,
+)
+from flink_parameter_server_tpu.telemetry.distributed import (
+    TraceCollector,
+    format_token,
+    new_trace,
+    parse_token,
+)
+from flink_parameter_server_tpu.telemetry.flightrec import (
+    FlightRecorder,
+    StormDetector,
+)
+from flink_parameter_server_tpu.telemetry.hotkeys import (
+    HotKeyAggregator,
+    HotKeySketch,
+)
+from flink_parameter_server_tpu.telemetry.slo import (
+    SLOEngine,
+    SLOSpec,
+    pull_latency_slo,
+)
+from flink_parameter_server_tpu.utils.initializers import (
+    ranged_random_factor,
+)
+from flink_parameter_server_tpu.utils.net import LineServer, request_lines
+
+import tools.check_metric_lines as lint
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.trace]
+
+
+@pytest.fixture()
+def registry():
+    reg = tm.MetricsRegistry(run_id="trace-test-run")
+    old = tm.get_registry()
+    tm.set_registry(reg)
+    yield reg
+    tm.set_registry(old)
+
+
+@pytest.fixture()
+def aggregator():
+    agg = HotKeyAggregator()
+    old = tm.get_aggregator()
+    tm.set_aggregator(agg)
+    yield agg
+    tm.set_aggregator(old)
+
+
+# ---------------------------------------------------------------------------
+# trace tokens + span identity
+# ---------------------------------------------------------------------------
+
+
+def test_trace_token_round_trip_and_tolerance():
+    ctx = new_trace()
+    assert format_token(ctx) == f"t={ctx.trace_id}:{ctx.span_id}"
+    back = parse_token(ctx.token())
+    assert back == ctx
+    # malformed tokens are None, never an error
+    for bad in (None, "", "nocolon", ":x", "x:", 17):
+        assert parse_token(bad) is None
+
+
+def test_span_trace_inheritance_same_thread():
+    tr = tm.SpanTracer()
+    ctx = new_trace()
+    with tr.span("root", "cluster", trace_id=ctx.trace_id,
+                 span_id=ctx.span_id):
+        with tr.span("child"):
+            pass
+    child, root = tr.spans()  # child exits (and records) first
+    assert root["trace_id"] == child["trace_id"] == ctx.trace_id
+    assert root["span_id"] == ctx.span_id
+    assert child["parent_id"] == ctx.span_id
+    # untraced spans carry None ids and no generation cost
+    with tr.span("plain"):
+        pass
+    assert tr.spans()[-1]["trace_id"] is None
+
+
+def test_explicit_parent_stitches_across_threads():
+    tr = tm.SpanTracer()
+    ctx = new_trace()
+
+    def worker():
+        with tr.span("remote", "cluster", trace_id=ctx.trace_id,
+                     parent_id=ctx.span_id):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    s = tr.spans()[-1]
+    assert s["trace_id"] == ctx.trace_id
+    assert s["parent_id"] == ctx.span_id
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-thread stack table stays bounded under connection churn
+# ---------------------------------------------------------------------------
+
+
+class _SpanEcho(LineServer):
+    """Every request records a span on its (per-connection) handler
+    thread — the churn pattern that must not leak stack entries."""
+
+    def __init__(self, tracer):
+        super().__init__(name="span-echo")
+        self.tracer = tracer
+
+    def respond(self, line):
+        with self.tracer.span("echo", "host"):
+            return "ok"
+
+
+def test_stack_table_bounded_under_connection_churn():
+    tr = tm.SpanTracer()
+    srv = _SpanEcho(tr).start()
+    try:
+        for _ in range(200):
+            with socket.create_connection(
+                (srv.host, srv.port), timeout=5
+            ) as s:
+                s.sendall(b"hi\n")
+                buf = b""
+                while b"\n" not in buf:
+                    buf += s.recv(64)
+        assert srv.connections_accepted == 200
+        # 200 dead handler threads must NOT mean 200 tracked stacks:
+        # the table prunes dead idents past its soft cap
+        assert tr.stack_count() <= 64, tr.stack_count()
+        assert len(tr) == 200  # every span still recorded
+    finally:
+        srv.stop()
+    assert srv.live_connections() == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: trace-token backward compatibility on the wire
+# ---------------------------------------------------------------------------
+
+
+class TestTraceBackcompat:
+    @pytest.fixture()
+    def shard_server(self):
+        def make(tracer=None):
+            part = RangePartitioner(16, 1)
+            shard = ParamShard(
+                0, part, (2,),
+                init_fn=ranged_random_factor(1, (2,)), registry=False,
+            )
+            server = ShardServer(
+                shard, supervised=False, tracer=tracer
+            ).start()
+            return part, shard, server
+
+        made = []
+
+        def factory(tracer=None):
+            t = make(tracer)
+            made.append(t)
+            return t
+
+        yield factory
+        for _part, _shard, server in made:
+            server.stop()
+
+    def test_new_client_tokens_against_untraced_server(self, shard_server):
+        """A PR-5-era server has no tracer; stamped frames round-trip
+        as plain requests (the key=value option grammar ignores t=)."""
+        _part, shard, server = shard_server(tracer=None)
+        payload = format_rows(np.ones((1, 2), np.float32), "b64")
+        resps = request_lines(server.host, server.port, [
+            "pull 0,1 b64 t=deadbeef:cafe01",
+            f"push 3 {payload} pid=tok.1 t=deadbeef:cafe02",
+            "xfer 0,1 t=deadbeef:cafe03",
+            "pull 0,1 b64 t=not-a-token",  # malformed: still served
+        ])
+        for r in resps:
+            assert r.startswith("ok"), r
+        assert shard.pulls_served == 2 and shard.pushes_applied == 1
+
+    def test_traced_server_without_client_tokens(self, shard_server):
+        """An old client sends no t=; the new server serves normally
+        and records trace-less spans (traces simply absent)."""
+        tr = tm.SpanTracer(process="shard-0")
+        _part, _shard, server = shard_server(tracer=tr)
+        resps = request_lines(
+            server.host, server.port, ["pull 0,1 b64", "stats"]
+        )
+        assert all(r.startswith("ok") for r in resps)
+        spans = tr.spans()
+        assert [s["name"] for s in spans] == ["shard.pull", "shard.stats"]
+        assert all(s["trace_id"] is None for s in spans)
+
+    def test_traced_client_against_untraced_server(self, shard_server):
+        part, _shard, server = shard_server(tracer=None)
+        ctr = tm.SpanTracer(process="client")
+        client = ClusterClient(
+            [(server.host, server.port)], part, (2,),
+            registry=False, tracer=ctr,
+        )
+        try:
+            vals = client.pull_batch(np.arange(4))
+            assert vals.shape == (4, 2)
+        finally:
+            client.close()
+        names = [s["name"] for s in ctr.spans()]
+        assert "pull_batch" in names and "pull.shard0" in names
+        by_name = {s["name"]: s for s in ctr.spans()}
+        assert (
+            by_name["pull.shard0"]["parent_id"]
+            == by_name["pull_batch"]["span_id"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# TraceCollector: ring merge + clock alignment
+# ---------------------------------------------------------------------------
+
+
+def test_collector_aligns_skewed_clocks():
+    client = tm.SpanTracer(process="client")
+    server = tm.SpanTracer(process="server")
+    ctx = new_trace()
+    base = time.perf_counter()
+    client.record(
+        "pull.shard0", base, base + 0.100, "cluster",
+        trace_id=ctx.trace_id, span_id="c1",
+    )
+    server.record(
+        "shard.pull", base + 0.020, base + 0.070, "cluster",
+        trace_id=ctx.trace_id, span_id="s1", parent_id="c1",
+    )
+    # simulate a 3.33 s wall-clock skew on the server host
+    server._epoch_wall += 3.33
+    col = TraceCollector().add(client).add(server)
+    off = col.offsets()
+    assert abs(off["server"] + 3.33) < 0.05, off
+    events = [e for e in col.merged_events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    c, s = by_name["pull.shard0"], by_name["shard.pull"]
+    # after alignment the server span sits INSIDE the client span
+    slack = 10_000  # 10 ms in µs
+    assert c["ts"] - slack <= s["ts"]
+    assert s["ts"] + s["dur"] <= c["ts"] + c["dur"] + slack
+    # and the merged doc is lint-clean
+    assert lint.check_trace_events(col.merged_events()) == []
+
+
+def test_collector_without_pairs_falls_back_to_wall():
+    a, b = tm.SpanTracer(process="a"), tm.SpanTracer(process="b")
+    t = time.perf_counter()
+    a.record("x", t, t + 0.01)
+    b.record("y", t, t + 0.01)
+    col = TraceCollector().add(a).add(b)
+    assert col.offsets() == {"a": 0.0, "b": 0.0}
+    evs = col.merged_events()
+    assert {e["pid"] for e in evs if e["ph"] == "X"} == {1, 2}
+
+
+# ---------------------------------------------------------------------------
+# the e2e acceptance anchor
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.elastic
+def test_e2e_hedged_pull_trace_spans_process_lanes(tmp_path):
+    """Train-while-serve on a 2-shard elastic cluster with a
+    chaos-injected straggler: collect every process ring, merge, and
+    find one pull trace spanning ≥ 2 process lanes with the hedged
+    backup visible.  The merged artifact lints clean."""
+    nu, ni, dim = 48, 64, 4
+    cols = synthetic_ratings(nu, ni, 10 * 64, seed=5)
+    batches = list(microbatches(cols, 64))
+    logic = OnlineMatrixFactorization(
+        nu, dim, updater=SGDUpdater(0.05), seed=1
+    )
+    driver = ElasticClusterDriver(
+        logic, capacity=ni, value_shape=(dim,),
+        init_fn=ranged_random_factor(7, (dim,)),
+        config=ElasticClusterConfig(
+            num_shards=2, num_workers=1, trace=True,
+            hedge_after_s=0.03, hedge_max_fraction=1.0,
+        ),
+        registry=False,
+    )
+    stop_serving = threading.Event()
+    with driver:
+        # chaos straggler: shard 0's server delays exactly one pull
+        victim = driver.servers[0]
+        orig_respond = victim.respond
+        armed = {"on": True}
+
+        def slow_respond(line):
+            if line.startswith("pull") and armed["on"]:
+                armed["on"] = False
+                time.sleep(0.3)
+            return orig_respond(line)
+
+        victim.respond = slow_respond
+
+        # the "serve" side: concurrent reads through their own client
+        serve_client = driver._make_client(worker="serve")
+
+        def serve_loop():
+            while not stop_serving.is_set():
+                try:
+                    serve_client.pull_batch(np.arange(16))
+                except Exception:
+                    pass
+                time.sleep(0.002)
+
+        st = threading.Thread(target=serve_loop, daemon=True)
+        st.start()
+        try:
+            driver.run(batches)
+        finally:
+            stop_serving.set()
+            st.join(timeout=10)
+            serve_client.close()
+
+        rings = driver.trace_rings()
+        assert len(rings) == 3  # client + 2 shards
+        col = TraceCollector()
+        for ring in rings:
+            col.add(ring)
+        path = str(tmp_path / "merged_trace.json")
+        col.export(path)
+
+    with open(path) as f:
+        doc = json.load(f)
+    assert lint.check_trace_events(doc) == []
+    xs = [e for e in doc if e["ph"] == "X"]
+    backups = [e for e in xs if e["name"] == "hedge.backup"]
+    assert backups, "no hedged backup recorded"
+    # the hedged pull's trace spans the client lane AND a shard lane
+    spanning = None
+    for b in backups:
+        tid = b["args"]["trace_id"]
+        assert tid is not None
+        lanes = {e["pid"] for e in xs if e["args"].get("trace_id") == tid}
+        names = {e["name"] for e in xs if e["args"].get("trace_id") == tid}
+        if len(lanes) >= 2 and any(n.startswith("shard.") for n in names):
+            spanning = (tid, lanes, names)
+            break
+    assert spanning is not None, "no pull trace spans >= 2 process lanes"
+    _tid, lanes, names = spanning
+    assert any(n.startswith("pull") for n in names), names
+    # the CLI lint agrees (the CI-shaped invocation)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(
+        lint.__file__
+    )))
+    proc = subprocess.run(
+        [sys.executable, "tools/check_metric_lines.py", "--trace", path],
+        cwd=repo, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# hot-key sketch: oracle accuracy, merge, /metrics exposure
+# ---------------------------------------------------------------------------
+
+
+class TestHotKeys:
+    def test_topk_matches_exact_oracle_on_zipf(self):
+        rng = np.random.default_rng(0)
+        ids = ((rng.zipf(1.3, 60_000) - 1) % 2_000).astype(np.int64)
+        sk = HotKeySketch(32)
+        for chunk in np.array_split(ids, 120):
+            sk.observe(chunk)
+        exact = np.bincount(ids, minlength=2_000)
+        top = sk.top_k(10)
+        assert [t["key"] for t in top] == np.argsort(-exact)[:10].tolist()
+        # documented bounds: count never underestimates, and
+        # overestimates by at most max(per-key err, cms ε·N)
+        bound = sk.error_bound()
+        for t in top:
+            true = int(exact[t["key"]])
+            assert true <= t["count"] <= true + max(t["err"], bound), (
+                t, true, bound,
+            )
+
+    def test_merge_across_shards_and_ops_topk_selection(self, aggregator):
+        rng = np.random.default_rng(1)
+        ids = ((rng.zipf(1.4, 30_000) - 1) % 500).astype(np.int64)
+        # shard-partition the stream by parity — each sketch sees HALF
+        a, b = HotKeySketch(16), HotKeySketch(16)
+        a.observe(ids[ids % 2 == 0])
+        b.observe(ids[ids % 2 == 1])
+        aggregator.register("shard-0", a)
+        aggregator.register("shard-1", b)
+        exact = np.bincount(ids, minlength=500)
+        merged_top = [t["key"] for t in aggregator.top_k(5)]
+        assert merged_top == np.argsort(-exact)[:5].tolist()
+        snap = aggregator.snapshot()
+        assert snap["total_observed"] == 30_000
+        assert snap["sketches"] == ["shard-0", "shard-1"]
+
+    def test_hot_keys_on_metrics_and_report(self, registry, aggregator):
+        sk = HotKeySketch(8)
+        sk.observe(np.array([7, 7, 7, 7, 3, 3, 1]))
+        aggregator.register("shard-0", sk)
+        txt = tm.prometheus_text(registry)
+        assert '# TYPE fps_hot_key_traffic gauge' in txt
+        assert 'fps_hot_key_traffic{key="7",rank="0"} 4' in txt
+        assert "fps_hot_key_error_bound" in txt
+        report = tm.build_run_report(registry)
+        assert report["hot_keys"]["top"][0]["key"] == 7
+        md = tm.render_markdown(report)
+        assert "Hot keys" in md
+
+    def test_cluster_driver_wires_shard_sketches(self, aggregator):
+        logic = OnlineMatrixFactorization(
+            16, 4, updater=SGDUpdater(0.05)
+        )
+        driver = ClusterDriver(
+            logic, capacity=32, value_shape=(4,),
+            init_fn=ranged_random_factor(2, (4,)),
+            config=ClusterConfig(
+                num_shards=2, num_workers=1, hot_keys=True, hot_key_k=8,
+            ),
+            registry=False,
+        )
+        cols = synthetic_ratings(16, 32, 4 * 64, seed=2)
+        with driver:
+            driver.run(list(microbatches(cols, 64)))
+            assert aggregator.labels() == ["shard-0", "shard-1"]
+            assert aggregator.total() > 0
+            assert aggregator.top_k(3)
+        # driver.stop() unregisters its sketches
+        assert aggregator.labels() == []
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn rates, verdicts, controller pressure
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def test_burn_rate_windows_and_verdicts(self, registry):
+        t = [0.0]
+        engine = SLOEngine(
+            [pull_latency_slo(0.025, target=0.9)],
+            registry=registry, windows=(10.0, 30.0), page_burn=2.0,
+            clock=lambda: t[0],
+        )
+        h = registry.histogram(
+            "cluster_pull_rtt_seconds", component="cluster"
+        )
+        engine.sample()  # baseline at t=0 with nothing observed
+        assert engine.status("pull_p99")["verdict"] == "no_data"
+        for _ in range(50):
+            h.observe(0.001)  # good
+        t[0] = 5.0
+        engine.sample()
+        assert engine.status("pull_p99")["verdict"] == "ok"
+        for _ in range(50):
+            h.observe(1.0)  # bad: way past 25 ms
+        t[0] = 6.0
+        engine.sample()
+        st = engine.status("pull_p99")
+        assert st["verdict"] == "breach", st
+        assert st["burn_short"] > 2.0 and st["burn_long"] > 2.0
+        assert engine.breached() == ["pull_p99"]
+        # the probe gauges render on /metrics under component=slo
+        txt = tm.prometheus_text(registry, include_hot_keys=False)
+        assert 'fps_slo_burn_rate{component="slo"' in txt
+        assert 'fps_slo_healthy{component="slo",slo="pull_p99"} 0' in txt
+        # and the run report carries the verdict roll-up
+        report = tm.build_run_report(registry)
+        assert report["slo"]["pull_p99"]["healthy"] is False
+        assert "SLO verdicts" in tm.render_markdown(report)
+
+    def test_bound_kind_over_gauges(self, registry):
+        t = [0.0]
+        spec = SLOSpec("staleness", "cluster_staleness_steps", 4.0,
+                       target=0.9, kind="bound")
+        engine = SLOEngine(
+            [spec], registry=registry, windows=(10.0, 30.0),
+            clock=lambda: t[0], register_gauges=False,
+        )
+        g = registry.gauge("cluster_staleness_steps", component="cluster")
+        g.set(1.0)
+        engine.sample()
+        t[0] = 1.0
+        g.set(100.0)  # past the bound: every sample now bad
+        for _ in range(8):
+            t[0] += 1.0
+            engine.sample()
+        st = engine.status("staleness")
+        assert st["verdict"] == "breach", st
+
+    def test_slo_breach_pressures_elastic_controller(self, registry):
+        class _StubDriver:
+            class _Part:
+                num_shards = 2
+
+            partitioner = _Part()
+            registry = None
+
+            def shard_alive(self, s):
+                return True
+
+        t = [0.0]
+        engine = SLOEngine(
+            [pull_latency_slo(0.025, target=0.9)],
+            registry=registry, windows=(10.0, 30.0),
+            clock=lambda: t[0], register_gauges=False,
+        )
+        h = registry.histogram(
+            "cluster_pull_rtt_seconds", component="cluster"
+        )
+        engine.sample()
+        for _ in range(100):
+            h.observe(1.0)
+        t[0] = 5.0
+        engine.sample()
+        # raw thresholds are parked out of reach: only the SLO signal
+        # can pressure the policy
+        ctl = ElasticController(
+            _StubDriver(), registry=registry, slo=engine,
+            policy=ScalePolicy(
+                scale_out_rtt_p99_s=1e9, min_window_frames=10**9,
+                scale_out_queue_depth=1e9, max_shards=4,
+            ),
+        )
+        decision = ctl.evaluate()
+        assert decision is not None and decision["action"] == "scale_out"
+        assert decision["slo_breaches"] == ["pull_p99"]
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, dumps, triggers, lint
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_format_and_lint(self, registry, tmp_path):
+        tr = tm.SpanTracer()
+        with tr.span("work", "train"):
+            pass
+        rec = FlightRecorder(
+            capacity=8, registry=registry, tracer=tr,
+            results_dir=str(tmp_path), min_dump_interval_s=0.0,
+        )
+        for i in range(12):
+            rec.note("epoch_flip", epoch=i)
+        assert len(rec.events()) == 8  # bounded ring
+        path = rec.dump("unit test reason!")
+        assert path is not None and os.path.exists(path)
+        assert os.path.basename(path) == "flightrec_unit_test_reason_.json"
+        with open(path) as f:
+            doc = json.load(f)
+        assert lint.check_flightrec(doc) == []
+        assert doc["reason"] == "unit test reason!"
+        assert doc["run_id"] == "trace-test-run"
+        assert doc["spans"][-1]["name"] == "work"
+        assert doc["events"][0]["kind"] == "epoch_flip"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            lint.__file__
+        )))
+        proc = subprocess.run(
+            [sys.executable, "tools/check_metric_lines.py",
+             "--flightrec", path],
+            cwd=repo, capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_dump_throttled_per_reason(self, tmp_path):
+        rec = FlightRecorder(
+            results_dir=str(tmp_path), min_dump_interval_s=60.0,
+        )
+        assert rec.dump("storm") is not None
+        assert rec.dump("storm") is None  # throttled
+        assert rec.dump("storm", force=True) is not None
+        assert rec.dump("other") is not None  # independent reason
+
+    def test_stall_watchdog_dumps_blackbox(self, registry, tmp_path):
+        from flink_parameter_server_tpu.resilience.health import (
+            HealthMonitor,
+            StallWatchdog,
+        )
+
+        t = [0.0]
+        mon = HealthMonitor(lambda: t[0], registry=False)
+        rec = FlightRecorder(
+            registry=registry, results_dir=str(tmp_path),
+            min_dump_interval_s=0.0,
+        )
+        wd = StallWatchdog(
+            mon, stall_after_s=1.0, registry=False, flightrec=rec,
+        )
+        mon.beat("ingest")
+        t[0] = 5.0
+        events = wd.check_once()
+        assert len(events) == 1
+        path = os.path.join(str(tmp_path), "flightrec_stall_ingest.json")
+        assert os.path.exists(path)
+        with open(path) as f:
+            doc = json.load(f)
+        assert lint.check_flightrec(doc) == []
+        assert doc["events"][-1]["kind"] == "stall"
+        # one dump per episode: re-polling while still stalled is quiet
+        t[0] = 6.0
+        assert wd.check_once() == []
+
+    def test_storm_detector_edge_triggers(self):
+        t = [0.0]
+        det = StormDetector(3, 10.0, clock=lambda: t[0])
+        assert not det.note() and not det.note()
+        assert det.note()  # third inside the window trips
+        assert not det.note()  # still storming: no re-trigger
+        t[0] = 100.0  # window drains
+        assert not det.note() and not det.note()
+        assert det.note()  # a NEW storm trips again
+        assert det.storms == 2
+
+    def test_client_stale_epoch_storm_dumps(self, tmp_path):
+        part = RangePartitioner(16, 1)
+        mem = MembershipService(part, [("127.0.0.1", 1)], registry=False)
+        rec = FlightRecorder(
+            results_dir=str(tmp_path), min_dump_interval_s=0.0,
+        )
+        client = ClusterClient(
+            value_shape=(2,), membership=mem, registry=False,
+            flightrec=rec, storm_threshold=3, storm_window_s=60.0,
+            retry_sleep_s=0.0,
+        )
+        deadline = time.monotonic() + 60.0
+        for attempt in range(3):
+            client._await_retry(deadline, attempt, "pull")
+        assert any("stale_epoch_storm" in p for p in rec.dumps)
+        assert rec.events()[-1]["kind"] == "stale_epoch_storm"
+
+
+# ---------------------------------------------------------------------------
+# satellite: strict HTTP on the /metrics endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_endpoint_strict_http_reader(registry, aggregator):
+    registry.counter("steps_total", component="train").inc(3)
+    sk = HotKeySketch(4)
+    sk.observe(np.array([9, 9, 2]))
+    aggregator.register("serving", sk)
+    srv = tm.TelemetryServer(registry).start()
+    try:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == (
+            "text/plain; version=0.0.4; charset=utf-8"
+        )
+        body = resp.read()
+        assert len(body) == int(resp.getheader("Content-Length"))
+        text = body.decode("utf-8")
+        assert "fps_steps_total" in text
+        assert 'fps_hot_key_traffic{key="9"' in text
+        conn.close()
+        # HEAD: same headers, empty body
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=5)
+        conn.request("HEAD", "/metrics")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert int(resp.getheader("Content-Length")) == len(body) or (
+            int(resp.getheader("Content-Length")) > 0
+        )
+        assert resp.read() == b""
+        conn.close()
+        # the hotkeys JSON path
+        out = tm.scrape(srv.host, srv.port, "hotkeys")
+        doc = json.loads(out)
+        assert doc["hot_keys"]["top"][0]["key"] == 9
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: report carries hedge win rate + SLO verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_report_hedge_win_rate(registry):
+    registry.counter(
+        "elastic_hedged_pulls_total", component="elastic"
+    ).inc(10)
+    registry.counter(
+        "elastic_hedges_won_total", component="elastic"
+    ).inc(4)
+    report = tm.build_run_report(registry)
+    assert report["elastic"]["hedge_win_rate"] == 0.4
+    md = tm.render_markdown(report)
+    assert "hedged pulls (won / win rate) | 10 (4 / 0.4)" in md
+
+
+def test_trace_lint_rejects_malformed(tmp_path):
+    good = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "x"}},
+        {"name": "a", "ph": "X", "ts": 0.0, "dur": 1.0, "pid": 1,
+         "tid": 1, "args": {"trace_id": None}},
+        {"name": "b", "ph": "X", "ts": 2.0, "dur": 1.0, "pid": 2,
+         "tid": 1, "args": {"trace_id": "ff"}},
+    ]
+    assert lint.check_trace_events(good) == []
+    assert lint.check_trace_events({"not": "a list"})
+    no_pid = [dict(good[1])]
+    del no_pid[0]["pid"]
+    assert any("pid" in p for p in lint.check_trace_events(no_pid))
+    unsorted = [good[2], good[1]]
+    assert any(
+        "monotone" in p for p in lint.check_trace_events(unsorted)
+    )
+    no_trace_key = [dict(good[1], args={"depth": 0})]
+    assert any(
+        "trace_id" in p for p in lint.check_trace_events(no_trace_key)
+    )
+    assert lint.check_flightrec([1, 2]) != []
+    assert any(
+        "reason" in p
+        for p in lint.check_flightrec({"pid": 1, "run_id": "x",
+                                       "events": []})
+    )

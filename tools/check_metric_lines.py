@@ -24,12 +24,27 @@ Registry samples (``"kind": "registry"``) additionally have every
 ``component=`` label checked against the known component set — a
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
+
+Two further artifact shapes from the observability plane lint here
+too (docs/observability.md):
+
+    python tools/check_metric_lines.py --trace merged_trace.json
+    python tools/check_metric_lines.py --flightrec flightrec_stall.json
+
+``--trace`` checks a Chrome trace-event JSON array (the
+``TraceCollector`` merge format): every ``X`` event carries ``pid``,
+numeric non-negative ``ts``, and a ``trace_id`` key in ``args``
+(``null`` allowed — the key records the decision); ``X`` events are
+timestamp-monotone.  ``--flightrec`` checks a flight-recorder dump:
+a JSON object with ``reason``/``pid``/``run_id``/``events``, every
+event carrying a numeric ``ts`` and ``kind``.  A mode flag applies to
+the paths that follow it.
 """
 from __future__ import annotations
 
 import json
 import sys
-from typing import Iterable, List, Tuple
+from typing import Any, Iterable, List, Tuple
 
 # every component label the repo's emitters stamp (docs/observability.md
 # instrument catalog + docs/cluster.md): new planes register here so
@@ -37,7 +52,7 @@ from typing import Iterable, List, Tuple
 # the HealthMonitor heartbeat component (resilience/health.py SERVING).
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
-     "serving_dispatch", "elastic"}
+     "serving_dispatch", "elastic", "slo"}
 )
 
 
@@ -102,23 +117,114 @@ def check_lines(
     return bad
 
 
+def check_trace_events(doc: Any) -> List[str]:
+    """Lint a merged Chrome trace (``TraceCollector`` format); returns
+    human-readable problems (empty = clean)."""
+    bad: List[str] = []
+    if not isinstance(doc, list):
+        return [f"trace document is {type(doc).__name__}, expected a "
+                f"JSON array of events"]
+    last_ts = None
+    for i, ev in enumerate(doc):
+        if not isinstance(ev, dict):
+            bad.append(f"event {i}: not an object")
+            continue
+        if "pid" not in ev:
+            bad.append(f"event {i} ({ev.get('name')!r}): missing 'pid'")
+        if ev.get("ph") != "X":
+            continue  # metadata events carry no timeline
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            bad.append(
+                f"event {i} ({ev.get('name')!r}): missing/negative 'ts'"
+            )
+            continue
+        if last_ts is not None and ts < last_ts:
+            bad.append(
+                f"event {i} ({ev.get('name')!r}): ts {ts} < previous "
+                f"{last_ts} — X events must be timestamp-monotone"
+            )
+        last_ts = ts
+        args = ev.get("args")
+        if not isinstance(args, dict) or "trace_id" not in args:
+            bad.append(
+                f"event {i} ({ev.get('name')!r}): args must carry a "
+                f"'trace_id' key (null for untraced spans)"
+            )
+    return bad
+
+
+def check_flightrec(doc: Any) -> List[str]:
+    """Lint a flight-recorder dump (telemetry/flightrec.py format)."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"flightrec document is {type(doc).__name__}, expected "
+                f"a JSON object"]
+    if not isinstance(doc.get("reason"), str) or not doc.get("reason"):
+        bad.append("missing/empty 'reason'")
+    if not isinstance(doc.get("pid"), int):
+        bad.append("missing/non-integer 'pid'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        bad.append("missing/non-list 'events'")
+        return bad
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            bad.append(f"event {i}: not an object")
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
+            bad.append(f"event {i}: missing/non-numeric 'ts'")
+        if not isinstance(ev.get("kind"), str):
+            bad.append(f"event {i}: missing/non-string 'kind'")
+    return bad
+
+
+def _check_json_artifact(path: str, checker) -> List[str]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        return [f"not valid JSON: {e}"]
+    return checker(doc)
+
+
 def main(argv: List[str]) -> int:
     require_ids = True
-    paths = []
+    mode = "lines"
+    jobs: List[Tuple[str, str]] = []  # (mode, path)
     for a in argv:
         if a == "--allow-missing-ids":
             require_ids = False
+        elif a == "--trace":
+            mode = "trace"
+        elif a == "--flightrec":
+            mode = "flightrec"
+        elif a == "--lines":
+            mode = "lines"
         elif a in ("-h", "--help"):
             print(__doc__)
             return 0
         else:
-            paths.append(a)
-    if not paths:
+            jobs.append((mode, a))
+    if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
-              "<file|-> ...", file=sys.stderr)
+              "[--trace|--flightrec|--lines] <file|-> ...",
+              file=sys.stderr)
         return 2
     failed = False
-    for path in paths:
+    for mode, path in jobs:
+        if mode in ("trace", "flightrec"):
+            checker = (
+                check_trace_events if mode == "trace" else check_flightrec
+            )
+            problems = _check_json_artifact(path, checker)
+            for reason in problems:
+                failed = True
+                print(f"{path}: {reason}", file=sys.stderr)
+            print(f"{path}: {mode} artifact, {len(problems)} problems")
+            continue
         if path == "-":
             lines = sys.stdin.read().splitlines()
             name = "<stdin>"
