@@ -3,14 +3,21 @@
 The acceptance bar for the telemetry PR (ISSUE 3) is quantitative:
 steps/sec with the observability plane enabled must sit within 3% of
 disabled on the CPU microbench.  ISSUE 6 widened the plane, so the ON
-arm now carries ALL of it: the registry + span tracer
-(``DriverConfig.telemetry``), a hot-key sketch observing every
-microbatch's item ids on the ingest path (telemetry/hotkeys.py), and
-an SLO engine sampling the registry on its own poll thread
-(telemetry/slo.py).  The OFF arm runs none of it.  Same logic, same
-store shapes, same stream; the result folds into
+arm carries the registry + span tracer (``DriverConfig.telemetry``), a
+hot-key sketch observing every microbatch's item ids on the ingest
+path (telemetry/hotkeys.py), and an SLO engine sampling the registry
+on its own poll thread (telemetry/slo.py).  ISSUE 7 widened it again:
+the ON arm now ALSO runs the sampling stack profiler
+(telemetry/profiler.py ``StackSampler``, default 100 ms interval) for
+the whole measured window.  The OFF arm runs none of it.  Same logic, same store
+shapes, same stream; the result folds into
 ``results/<platform>/run_report.{md,json}`` (the page
-docs/perf_status.md says future bench deltas must cite).
+docs/perf_status.md says future bench deltas must cite).  ``main()``
+additionally runs the latency-budget cluster round
+(``benchmarks/latency_budget.py`` — phase timers + wire byte
+accounting on a real TCP topology, the paths the driver microbench
+cannot exercise) before writing the report, so the committed
+run_report carries the budget section.
 
 Methodology: interleaved reps (on, off, on, off, ...) so drift in the
 shared CPU hits both arms equally; per-arm rate = median of reps; the
@@ -45,7 +52,7 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
     """One driver run; returns steps/sec (dispatch loop only).  With
     ``telemetry`` on, the FULL observability plane rides along:
     registry + spans (driver config), a hot-key sketch on the ingest
-    path, and a polling SLO engine."""
+    path, a polling SLO engine, and the sampling stack profiler."""
     from flink_parameter_server_tpu.core.store import ShardedParamStore
     from flink_parameter_server_tpu.data.streams import microbatches
     from flink_parameter_server_tpu.models.matrix_factorization import (
@@ -53,6 +60,7 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
         SGDUpdater,
     )
     from flink_parameter_server_tpu.telemetry.hotkeys import HotKeySketch
+    from flink_parameter_server_tpu.telemetry.profiler import StackSampler
     from flink_parameter_server_tpu.telemetry.slo import (
         SLOEngine,
         pull_latency_slo,
@@ -84,6 +92,7 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
     )
     stream = microbatches(data, batch, epochs=1)
     slo_engine = None
+    sampler = None
     if telemetry:
         sketch = HotKeySketch(32)
 
@@ -99,12 +108,18 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
             [pull_latency_slo(), serving_latency_slo()],
             windows=(1.0, 5.0), register_gauges=False,
         ).start(interval_s=0.02)
+        # the sampling stack profiler walks every live thread's frames
+        # at its default interval — its cost (tick + GIL preemption
+        # tax) is paid INSIDE the measured window
+        sampler = StackSampler().start()
     t0 = time.perf_counter()
     try:
         driver.run(stream)
     finally:
         if slo_engine is not None:
             slo_engine.stop()
+        if sampler is not None:
+            sampler.stop()
     dt = time.perf_counter() - t0
     return driver.step_idx / dt
 
@@ -176,11 +191,18 @@ def main() -> None:
     )
     print(json.dumps({
         "metric": "telemetry overhead (registry+spans+hot-key sketch"
-                  "+SLO engine on vs off, CPU driver microbench)",
+                  "+SLO engine+stack sampler on vs off, CPU driver "
+                  "microbench)",
         "value": r["overhead_pct"],
         "unit": "% slowdown (negative = within noise, faster)",
         "extra": r,
     }))
+    # the latency-budget cluster round: phase timers + byte accounting
+    # on a real TCP topology (the paths the driver microbench cannot
+    # exercise) — its phases land in the same registry the report reads
+    from benchmarks.latency_budget import run_budget_bench
+
+    b = run_budget_bench()
     # the A/B left the ON arm's numbers in the default registry — the
     # run report rolls them up with the overhead verdict attached
     report = tm.build_run_report(extra={
@@ -191,6 +213,12 @@ def main() -> None:
         "overhead_bench": (
             f"{args.steps} steps x batch {args.batch}, "
             f"{args.reps} interleaved reps, platform {r['platform']}"
+        ),
+        "budget_oracle_pull_p50_ms": b["oracle_pull_p50_ms"],
+        "budget_round_ms": b["budget_round_ms"],
+        "budget_coverage_error": b["coverage_error"],
+        "budget_top_phase": (
+            f"{b['top_phase']} ({b['top_pct']}% of pull round)"
         ),
     })
     paths = tm.write_run_report(report, platform=r["platform"])

@@ -68,7 +68,9 @@ import numpy as np
 from ..core.api import ParameterServerClient
 from ..ops.dedup import aggregate_deltas, coalesce_ids
 from ..telemetry.distributed import TraceContext, format_token, new_trace
+from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
 from ..telemetry.spans import gen_id
+from ..utils.net import _safe_verb, client_meter
 from .partition import Partitioner
 from .shard import format_rows, parse_rows
 
@@ -110,19 +112,28 @@ class ShardConnection:
         self._rfile = self._sock.makefile("rb")
         self.inflight = 0
         self.requests_sent = 0
+        # client-role wire ledger (utils/net.py): bytes/frames per
+        # verb, each direction — the other endpoint of the shard
+        # servers' accounting
+        self._meter = client_meter()
 
     def request_many(self, lines: Sequence[str]) -> List[str]:
         """Pipelined request/response: send up to ``window`` frames
         ahead of the reads, return one response line per request."""
         out: List[str] = []
         pending = 0
+        pending_verbs: List[str] = []
         it = iter(lines)
         sent = 0
         total = len(lines)
         while sent < total or pending:
             while pending < self.window and sent < total:
                 line = next(it)
-                self._sock.sendall(line.encode("utf-8") + b"\n")
+                data = line.encode("utf-8") + b"\n"
+                self._sock.sendall(data)
+                verb = _safe_verb(line)
+                self._meter.count("out", verb, len(data))
+                pending_verbs.append(verb)
                 pending += 1
                 sent += 1
                 self.inflight = pending
@@ -133,6 +144,7 @@ class ShardConnection:
                     f"shard {self.host}:{self.port} closed mid-pipeline "
                     f"({len(out)}/{total} responses)"
                 )
+            self._meter.count("in", pending_verbs.pop(0), len(raw))
             out.append(raw.decode("utf-8", "replace").rstrip("\n"))
             pending -= 1
             self.inflight = pending
@@ -216,6 +228,7 @@ class ClusterClient(ParameterServerClient):
         flightrec=None,
         storm_threshold: int = 25,
         storm_window_s: float = 5.0,
+        profiler=None,
     ):
         if membership is None:
             if addresses is None or partitioner is None:
@@ -309,6 +322,13 @@ class ClusterClient(ParameterServerClient):
             self._h_rtt = None
             self._c_refresh = None
             self._c_storms = None
+        # latency-budget phases (telemetry/profiler.py): per-frame
+        # client serialize / round trip / parse — the client side of
+        # the budget.  registry=False implies profiling off too.
+        self._profiler = (
+            NULL_PROFILER if registry is False and profiler is None
+            else resolve_profiler(profiler)
+        )
 
     # -- observability ------------------------------------------------------
     def inflight(self) -> int:
@@ -658,45 +678,55 @@ class ClusterClient(ParameterServerClient):
         chunks = [
             ids[i: i + self.chunk] for i in range(0, len(ids), self.chunk)
         ]
+        prof = self._profiler
         tok, span_cm, span_id = self._frame_trace(shard, "pull", ctx)
         suffix = self._frame_suffix() + tok
-        lines = [
-            "pull " + ",".join(str(int(i)) for i in c)
-            + (" b64" if self.wire_format == "b64" else " text")
-            + suffix
-            for c in chunks
-        ]
         trace = (
             (self._tracer, ctx.trace_id, span_id)
             if span_id is not None else None
         )
-        t0 = time.perf_counter()
+        rows = []
+        rejected: List[np.ndarray] = []
+        # the pull.shard<k> span covers the WHOLE per-shard round —
+        # serialize, wire round trip, response parse — which makes it
+        # the independent oracle the latency-budget phases (observed
+        # separately below) must sum to (tests/test_profiler.py)
         with span_cm:
+            t_ser = time.perf_counter()
+            lines = [
+                "pull " + ",".join(str(int(i)) for i in c)
+                + (" b64" if self.wire_format == "b64" else " text")
+                + suffix
+                for c in chunks
+            ]
+            ser_per = (time.perf_counter() - t_ser) / max(1, len(lines))
+            t0 = time.perf_counter()
             resps = self._request_frames(
                 shard, ids, lines, hedgeable=True, trace=trace
             )
-        if self._h_rtt is not None:
             # one observation per chunk frame: the pipelined per-frame
             # turnaround, amortised (total wall / frames)
             per = (time.perf_counter() - t0) / max(1, len(lines))
             for _ in lines:
-                self._h_rtt.observe(per)
-        rows = []
-        rejected: List[np.ndarray] = []
-        for resp, c in zip(resps, chunks):
-            if _is_reject(resp) and self.membership is not None:
-                rejected.append(c)
-                continue
-            _check_ok(resp, f"pull shard {shard}")
-            _, _, body = resp.partition(" ")
-            _, _, body = body.partition(" ")  # strip "n=<k>"
-            vals = parse_rows(body, self.value_shape)
-            if len(vals) != len(c):
-                raise RuntimeError(
-                    f"shard {shard} answered {len(vals)} rows for "
-                    f"{len(c)} ids"
-                )
-            rows.append(vals)
+                if self._h_rtt is not None:
+                    self._h_rtt.observe(per)
+                prof.observe("pull", "rtt", per)
+                prof.observe("pull", "client_serialize", ser_per)
+            for resp, c in zip(resps, chunks):
+                if _is_reject(resp) and self.membership is not None:
+                    rejected.append(c)
+                    continue
+                _check_ok(resp, f"pull shard {shard}")
+                _, _, body = resp.partition(" ")
+                _, _, body = body.partition(" ")  # strip "n=<k>"
+                with prof.timer("pull", "client_parse"):
+                    vals = parse_rows(body, self.value_shape)
+                if len(vals) != len(c):
+                    raise RuntimeError(
+                        f"shard {shard} answered {len(vals)} rows for "
+                        f"{len(c)} ids"
+                    )
+                rows.append(vals)
         if rejected:
             # partial answers cannot scatter into the output without
             # per-chunk bookkeeping; pulls are idempotent, so replay
@@ -714,23 +744,35 @@ class ClusterClient(ParameterServerClient):
         pid: Optional[str] = None,
         ctx=None,
     ) -> None:
+        prof = self._profiler
         tok, span_cm, _span_id = self._frame_trace(shard, "push", ctx)
         suffix = self._frame_suffix(pid) + tok
         lines = []
         chunks = []
-        for i in range(0, len(ids), self.chunk):
-            c_ids = ids[i: i + self.chunk]
-            c_del = deltas[i: i + self.chunk]
-            chunks.append(c_ids)
-            lines.append(
-                "push "
-                + ",".join(str(int(x)) for x in c_ids)
-                + " "
-                + format_rows(c_del, self.wire_format)
-                + suffix
-            )
+        # like pull: the push.shard<k> span covers serialize + round
+        # trip, the same window the push phases decompose
         with span_cm:
-            resps = self._request_frames(shard, ids, lines, hedgeable=False)
+            t_ser = time.perf_counter()
+            for i in range(0, len(ids), self.chunk):
+                c_ids = ids[i: i + self.chunk]
+                c_del = deltas[i: i + self.chunk]
+                chunks.append(c_ids)
+                lines.append(
+                    "push "
+                    + ",".join(str(int(x)) for x in c_ids)
+                    + " "
+                    + format_rows(c_del, self.wire_format)
+                    + suffix
+                )
+            ser_per = (time.perf_counter() - t_ser) / max(1, len(lines))
+            t0 = time.perf_counter()
+            resps = self._request_frames(
+                shard, ids, lines, hedgeable=False
+            )
+            per = (time.perf_counter() - t0) / max(1, len(lines))
+            for _ in lines:
+                prof.observe("push", "rtt", per)
+                prof.observe("push", "client_serialize", ser_per)
         rejected: List[np.ndarray] = []
         for resp, c_ids in zip(resps, chunks):
             if _is_reject(resp) and self.membership is not None:

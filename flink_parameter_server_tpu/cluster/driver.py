@@ -88,6 +88,12 @@ class ClusterConfig:
     # shards on /metrics and in run_report
     hot_keys: bool = False
     hot_key_k: int = 32
+    # latency-budget profiler (telemetry/profiler.py): per-phase cost
+    # attribution on every pull/push round (client serialize → wire →
+    # queue wait → WAL → scatter → serialize → parse).  On by default —
+    # measured within the ≤3% telemetry overhead bar; False switches
+    # every phase timer to the shared no-op.
+    profile: bool = True
 
 
 @dataclasses.dataclass
@@ -205,6 +211,7 @@ class ClusterDriver:
             wal_dir=self._wal_dir_for(shard_id),
             registry=self.registry if self.registry is not None else False,
             hotkeys=hotkeys,
+            profiler=None if cfg.profile else False,
         )
         server = ShardServer(
             shard, cfg.host, 0, supervised=cfg.supervised, tracer=tracer
@@ -256,6 +263,7 @@ class ClusterDriver:
             registry=self.registry if self.registry is not None else False,
             worker=worker,
             tracer=self.client_tracer,
+            profiler=None if cfg.profile else False,
         )
 
     def trace_rings(self) -> List:

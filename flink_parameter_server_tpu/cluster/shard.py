@@ -23,6 +23,8 @@ contract for observability options)::
     load <id1,id2,...> <payload>             # row ASSIGNMENT (migration)
     flush                                    # fsync the WAL, ack counters
     stats                                    # one-line JSON shard stats
+    conns                                    # live connection ledger
+                                             # (psctl conns)
 
     ok n=<k> <payload>                    # pull answer
     ok applied=<k> seq=<n>                # push answer
@@ -201,6 +203,7 @@ class ParamShard:
         wal_fsync_every: int = 0,
         registry=None,
         hotkeys=None,
+        profiler=None,
     ):
         self.shard_id = int(shard_id)
         self.partitioner = partitioner
@@ -222,6 +225,16 @@ class ParamShard:
         # attached, every pulled/pushed id batch is observed — the
         # Zipf-skew measurement gating the serving hot-key tier
         self.hotkeys = hotkeys
+        # latency-budget phases (telemetry/profiler.py): lock wait =
+        # server_queue_wait (concurrent connections serialize on this
+        # shard's lock), WAL append, scatter/apply — the server side of
+        # the per-round budget.  registry=False implies profiling off.
+        from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
+
+        self._profiler = (
+            NULL_PROFILER if registry is False and profiler is None
+            else resolve_profiler(profiler)
+        )
         self.pushes_applied = 0
         self.pulls_served = 0
         self.restarts = 0
@@ -446,13 +459,22 @@ class ParamShard:
     def pull(
         self, global_ids: np.ndarray, *, epoch: Optional[int] = None
     ) -> np.ndarray:
+        prof = self._profiler
+        t_wait = time.perf_counter()
         with self._lock:
+            prof.observe(
+                "pull", "server_queue_wait",
+                time.perf_counter() - t_wait,
+            )
             self._check_alive()
             ids = np.asarray(global_ids, np.int64)
             local = self._route(ids, epoch)
-            if self._host_mirror is None:
-                self._host_mirror = np.asarray(self.store.values())
-            vals = self._host_mirror[local]
+            with prof.timer("pull", "scatter_apply"):
+                # the pull-side table access: (re)build the host mirror
+                # if a push invalidated it, then one fancy-index gather
+                if self._host_mirror is None:
+                    self._host_mirror = np.asarray(self.store.values())
+                vals = self._host_mirror[local]
             self.pulls_served += 1
             if self.hotkeys is not None:
                 self.hotkeys.observe(ids)
@@ -473,7 +495,13 @@ class ParamShard:
         (old-epoch writes are rejected, never absorbed); ``pid`` makes
         the push idempotent per ``(pid, id)`` — the already-applied
         subset of a retried frame is acked without re-applying."""
+        prof = self._profiler
+        t_wait = time.perf_counter()
         with self._lock:
+            prof.observe(
+                "push", "server_queue_wait",
+                time.perf_counter() - t_wait,
+            )
             self._check_alive()
             if epoch is not None and epoch < self.epoch:
                 raise StaleEpoch(self.epoch, "old-epoch write rejected")
@@ -503,9 +531,11 @@ class ParamShard:
                 payload = {"ids": ids, "deltas": deltas}
                 if pid is not None:
                     payload["pid"] = pid
-                self._wal.append(self._push_seq, 1, payload)
+                with prof.timer("push", "wal_append"):
+                    self._wal.append(self._push_seq, 1, payload)
             self._push_seq += 1
-            self._apply(ids, deltas)
+            with prof.timer("push", "scatter_apply"):
+                self._apply(ids, deltas)
             self.rows_applied += int(len(ids))
             if pid is not None:
                 self._remember_pairs(pid, ids)
@@ -753,6 +783,13 @@ class ParamShard:
                     0 if self._frozen is None else int(len(self._frozen))
                 ),
                 "staged": len(self._staged),
+                # live depth figures the psctl stats view reads: WAL
+                # records durably appended and the exactly-once dedupe
+                # window's current size (bounded by pid_window)
+                "wal_records": (
+                    0 if self._wal is None else self._wal.records_appended
+                ),
+                "dedupe_pairs": len(self._applied_pairs),
             }
 
     def close(self) -> None:
@@ -783,6 +820,7 @@ class ShardServer(LineServer):
         restart_policy=None,
         max_line_bytes: int = 64 << 20,
         tracer=None,
+        profiler=None,
     ):
         super().__init__(
             host, port, name=f"shard-{shard.shard_id}",
@@ -790,6 +828,16 @@ class ShardServer(LineServer):
         )
         self.shard = shard
         self.supervised = supervised
+        # latency-budget phases (telemetry/profiler.py): whole-request
+        # server wall (the "wire" residual's subtrahend), inbound parse
+        # and response serialize — default to the shard's profiler so
+        # client+server phases land in one budget
+        from ..telemetry.profiler import resolve_profiler
+
+        self.profiler = (
+            shard._profiler if profiler is None
+            else resolve_profiler(profiler)
+        )
         # server-side spans (telemetry/distributed.py): each request is
         # wrapped in a span tagged with the inbound t=<trace>:<span>
         # context, so this process's ring can be merged into the
@@ -811,10 +859,18 @@ class ShardServer(LineServer):
     # -- the protocol ------------------------------------------------------
     def respond(self, line: str) -> str:
         self.shard._active_requests += 1
+        verb = line.split(None, 1)[0].lower() if line else ""
+        t0 = time.perf_counter()
         try:
             return self._respond_supervised(line)
         finally:
             self.shard._active_requests -= 1
+            if verb in ("pull", "push"):
+                # the whole-request server wall: what the client's RTT
+                # minus this equals is the wire cost (profiler budget)
+                self.profiler.observe(
+                    verb, "server_total", time.perf_counter() - t0
+                )
 
     def _respond_supervised(self, line: str) -> str:
         attempt = 0
@@ -903,17 +959,21 @@ class ShardServer(LineServer):
             elif rest and "=" not in rest[0]:
                 raise ValueError(f"pull format {rest[0]!r}: 'text' | 'b64'")
             opts = self._parse_opts(rest)
-            ids = parse_ids(toks[1])
+            with self.profiler.timer("pull", "server_parse"):
+                ids = parse_ids(toks[1])
             vals = self.shard.pull(ids, epoch=opts.get("e"))
-            return f"ok n={len(ids)} {format_rows(vals, enc)}"
+            with self.profiler.timer("pull", "response_serialize"):
+                body = format_rows(vals, enc)
+            return f"ok n={len(ids)} {body}"
         if cmd == "push":
             if len(toks) < 3:
                 raise ValueError(
                     "usage: push <id1,id2,...> <row1;row2;...> "
                     "[pid=<token>] [e=<epoch>]"
                 )
-            ids = parse_ids(toks[1])
-            deltas = parse_rows(toks[2], self.shard.value_shape)
+            with self.profiler.timer("push", "server_parse"):
+                ids = parse_ids(toks[1])
+                deltas = parse_rows(toks[2], self.shard.value_shape)
             if len(deltas) != len(ids):
                 raise ValueError(
                     f"{len(ids)} ids but {len(deltas)} delta rows"
@@ -947,8 +1007,13 @@ class ShardServer(LineServer):
             return f"ok pushes={f['pushes']} wal_records={f['wal_records']}"
         if cmd == "stats":
             return "ok " + json.dumps(self.shard.stats())
+        if cmd == "conns":
+            # psctl debug verb: the live per-connection wire ledger
+            # (utils/net.py ConnStats) of THIS shard's front end
+            return "ok " + json.dumps(self.conn_table())
         raise ValueError(
-            f"unknown command {cmd!r} (pull|push|xfer|load|flush|stats)"
+            f"unknown command {cmd!r} "
+            f"(pull|push|xfer|load|flush|stats|conns)"
         )
 
 

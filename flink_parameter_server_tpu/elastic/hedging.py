@@ -106,11 +106,22 @@ class Hedger:
         *,
         budget: Optional[HedgeBudget] = None,
         registry=None,
+        profiler=None,
     ):
         if after_s <= 0:
             raise ValueError(f"after_s={after_s}: must be > 0")
         self.after_s = float(after_s)
         self.budget = budget if budget is not None else HedgeBudget()
+        # latency-budget phases (telemetry/profiler.py): each race leg
+        # is observed as phase_seconds{verb="hedge", phase=primary|
+        # backup}, so the budget view shows what the straggler cost
+        # and what the backup leg bought
+        from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
+
+        self._profiler = (
+            NULL_PROFILER if registry is False and profiler is None
+            else resolve_profiler(profiler)
+        )
         self._spares: Dict[Tuple[str, int], _Spare] = {}
         self._lock = threading.Lock()
         self.hedges_issued = 0
@@ -199,7 +210,7 @@ class Hedger:
                     )
                 else:
                     span_cm = _NULL_CM
-                with span_cm:
+                with span_cm, self._profiler.timer("hedge", tag):
                     resps = c.request_many(list(lines))
                 with lock:
                     state.setdefault("winner", (tag, resps))

@@ -428,12 +428,20 @@ class ServingServer(LineServer):
         *,
         request_timeout: float = 30.0,
         max_line_bytes: int = 1 << 20,
+        profiler=None,
     ):
         super().__init__(
             host, port, name="serving", max_line_bytes=max_line_bytes
         )
         self.service = service
         self.request_timeout = float(request_timeout)
+        # latency-budget phases (telemetry/profiler.py): request parse
+        # + admission, dispatch wait, response serialize — verb-scoped
+        # as serving_<cmd> so the serve path has its own budget next to
+        # the cluster pull/push one
+        from ..telemetry.profiler import resolve_profiler
+
+        self.profiler = resolve_profiler(profiler)
 
     def start(self) -> "ServingServer":
         self.service.start()
@@ -442,19 +450,28 @@ class ServingServer(LineServer):
 
     # -- the protocol ------------------------------------------------------
     def respond(self, line: str) -> str:
+        verb = "serving_" + (
+            line.split(None, 1)[0].lower() if line.strip() else "empty"
+        )
+        prof = self.profiler
         try:
-            fut = self._admit(line)
+            with prof.timer(verb, "server_parse"):
+                fut = self._admit(line)
         except QueueFull:
             return "err overloaded"
         except ValueError as e:
             return f"err bad-request: {e}"
         try:
-            res = fut.result(self.request_timeout)
+            with prof.timer(verb, "server_queue_wait"):
+                # admission → batched dispatch → future resolution: the
+                # serve path's queue-wait analogue
+                res = fut.result(self.request_timeout)
         except NoSnapshotError:
             return "err no-snapshot"
         except Exception as e:
             return f"err internal: {type(e).__name__}: {e}"
-        return format_response(res)
+        with prof.timer(verb, "response_serialize"):
+            return format_response(res)
 
     def _admit(self, line: str) -> Future:
         parts = line.split()

@@ -24,6 +24,10 @@ through, and the seam every later perf PR is judged through:
     or stale-epoch storm.
   * :mod:`.slo` — declarative objectives evaluated as multi-window
     burn rates, consumable by the elastic controller.
+  * :mod:`.profiler` — the latency-budget profiler: per-phase cost
+    attribution of every cluster round (client serialize → wire →
+    queue wait → WAL → scatter → serialize → parse), plus a sampling
+    :class:`StackSampler` with folded-stack/flamegraph export.
 """
 from .distributed import (
     TraceCollector,
@@ -40,6 +44,13 @@ from .hotkeys import (
     SpaceSavingTopK,
     get_aggregator,
     set_aggregator,
+)
+from .profiler import (
+    PHASES,
+    PhaseProfiler,
+    StackSampler,
+    get_profiler,
+    set_profiler,
 )
 from .slo import SLOEngine, SLOSpec, default_slos
 from .registry import (
@@ -93,4 +104,9 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "default_slos",
+    "PHASES",
+    "PhaseProfiler",
+    "StackSampler",
+    "get_profiler",
+    "set_profiler",
 ]

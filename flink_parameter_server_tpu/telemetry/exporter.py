@@ -149,6 +149,7 @@ class TelemetryServer(LineServer):
         stall_after_s: Optional[float] = None,
         max_request_bytes: int = 8192,
         collectors=None,
+        profiler=None,
     ):
         super().__init__(host, port, name="telemetry")
         self.registry = registry if registry is not None else get_registry()
@@ -156,6 +157,10 @@ class TelemetryServer(LineServer):
         self.stall_after_s = stall_after_s
         self.max_request_bytes = int(max_request_bytes)
         self.collectors = list(collectors) if collectors else []
+        # the profiler whose latency budget the `budget` path serves
+        # (None = the process default, resolved per request so a late
+        # set_profiler() is picked up)
+        self.profiler = profiler
 
     def start(self) -> "TelemetryServer":
         super().start()
@@ -201,11 +206,45 @@ class TelemetryServer(LineServer):
             ) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("budget"):
+            # the latency-budget profiler's per-verb phase breakdown
+            # (telemetry/profiler.py) — the `psctl budget` answer
+            from .profiler import get_profiler
+
+            prof = (
+                self.profiler if self.profiler is not None
+                else get_profiler()
+            )
+            body = json.dumps(
+                {"budgets": prof.budget_report(),
+                 "run_id": self.registry.run_id}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
+        elif path.startswith("conns"):
+            # this endpoint's own live connection ledger (the shard
+            # servers answer their own over the `conns` wire verb)
+            body = json.dumps({"conns": self.conn_table()}) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         else:
-            body = f"unknown path {path!r} (metrics|healthz|hotkeys)\n"
+            body = (
+                f"unknown path {path!r} "
+                f"(metrics|healthz|hotkeys|budget|conns)\n"
+            )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"
         payload = body.encode("utf-8")
+        # wire accounting (utils/net.py): one frame each way per
+        # scrape, attributed to the path as the verb
+        verb = path.split("?", 1)[0][:16] or "metrics"
+        if not verb.replace("_", "").isalnum():
+            verb = "other"
+        stats = self._stats_for(conn)
+        stats.last_verb = verb
+        stats.bytes_in += len(buf)
+        stats.frames_in += 1
+        self.meter.count("in", verb, len(buf))
         if http:
             head = (
                 f"HTTP/1.0 {status}\r\n"
@@ -215,9 +254,14 @@ class TelemetryServer(LineServer):
             ).encode("ascii")
             # HEAD answers headers (with the GET body's exact
             # Content-Length) and no body — RFC 9110 §9.3.2
-            conn.sendall(head if head_only else head + payload)
+            sent = head if head_only else head + payload
+            conn.sendall(sent)
         else:
-            conn.sendall(payload)
+            sent = payload
+            conn.sendall(sent)
+        stats.bytes_out += len(sent)
+        stats.frames_out += 1
+        self.meter.count("out", verb, len(sent))
 
     def _healthz(self) -> dict:
         out = {"status": "ok", "run_id": self.registry.run_id}

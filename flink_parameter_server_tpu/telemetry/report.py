@@ -144,6 +144,12 @@ def build_run_report(
         round(report["elastic"]["hedges_won"] / hedged, 4)
         if hedged else None
     )
+    budget = _latency_budget_section()
+    if budget:
+        report["latency_budget"] = budget
+    net = _net_section(snap)
+    if net:
+        report["net"] = net
     slo = _slo_section(snap)
     if slo:
         report["slo"] = slo
@@ -153,6 +159,32 @@ def build_run_report(
     if extra:
         report["extra"] = dict(extra)
     return report
+
+
+def _latency_budget_section() -> Dict[str, Any]:
+    """Per-verb phase budgets from the process profiler
+    (telemetry/profiler.py) — empty when no phases were observed.
+    This is the section docs/perf_status.md cites as the required
+    evidence for the ROADMAP item 2 transport rework: it names the
+    top cost center of a round with its % of round time."""
+    from .profiler import get_profiler
+
+    return get_profiler().budget_report()
+
+
+def _net_section(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """Bytes/frames on the wire by (role, direction), summed over
+    verbs (utils/net.py accounting) — the baseline ROADMAP item 4's
+    "bytes down" criterion is judged against."""
+    out: Dict[str, Any] = {}
+    for name, kind in (("net_bytes_total", "bytes"),
+                       ("net_frames_total", "frames")):
+        for s in snap.get(name, ()):
+            role = s["labels"].get("role", "?")
+            direction = s["labels"].get("direction", "?")
+            key = f"{role}_{kind}_{direction}"
+            out[key] = int(out.get(key, 0) + (s["value"] or 0))
+    return out
 
 
 def _slo_section(snap: Dict[str, Any]) -> Dict[str, Any]:
@@ -249,6 +281,40 @@ def render_markdown(report: Dict[str, Any]) -> str:
             f"| shard replacements | {e['shard_replacements']} |",
             f"| stale-epoch storms | {e.get('stale_epoch_storms', 0)} |",
         ]
+    net = report.get("net")
+    if net:
+        lines.append(
+            f"| wire bytes (server in / out) | "
+            f"{net.get('server_bytes_in', 0)} / "
+            f"{net.get('server_bytes_out', 0)} |"
+        )
+        lines.append(
+            f"| wire frames (server in / out) | "
+            f"{net.get('server_frames_in', 0)} / "
+            f"{net.get('server_frames_out', 0)} |"
+        )
+    budget = report.get("latency_budget")
+    if budget:
+        lines += ["", "## Latency budget", ""]
+        for verb in sorted(budget):
+            b = budget[verb]
+            if not b.get("round_ms"):
+                continue
+            lines.append(
+                f"**{verb}**: round p50 {b['round_ms']} ms over "
+                f"{b['rounds']} frames — top cost center: "
+                f"`{b['top_phase']}` ({b['top_pct']}% of round time, "
+                f"coverage: {b['coverage']})"
+            )
+            lines.append("")
+            lines += ["| phase | p50 ms | mean ms | % of round |",
+                      "|---|---|---|---|"]
+            for p in b["phases"]:
+                lines.append(
+                    f"| {p['phase']} | {p['p50_ms']} | {p['mean_ms']} "
+                    f"| {p['pct']} |"
+                )
+            lines.append("")
     slo = report.get("slo")
     if slo:
         lines += ["", "## SLO verdicts", ""]

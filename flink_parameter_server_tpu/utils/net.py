@@ -25,12 +25,149 @@ start/stop cycles in one process (the elastic scale-in/out path) must
 not leak a thread per connection ever accepted; the context
 manager form pairs them.  ``port=0`` binds an ephemeral port — read it
 back from ``.port`` (the test/fixture pattern every front end uses).
+
+Wire accounting (the latency-budget profiler's byte ledger,
+docs/observability.md): every frame through the line loop — and every
+frame the :func:`request_lines` client helper moves — is counted into
+the metrics registry as ``net_bytes_total`` / ``net_frames_total``
+with ``{direction=in|out, verb=<first token>, role=server|client}``
+labels (``fps_``-prefixed on ``/metrics``).  Until this existed,
+bytes-on-wire was invisible: ROADMAP item 4's "bytes down" acceptance
+criterion had no baseline, and ROADMAP item 2's framing rework had no
+number to beat.  Per-connection totals (bytes/frames each way, peer,
+age) are kept too and served by :meth:`LineServer.conn_table` — the
+``psctl conns`` surface.
 """
 from __future__ import annotations
 
 import socket
 import threading
-from typing import List, Optional
+import time
+from typing import Dict, List, Optional
+
+
+def _safe_verb(line: str) -> str:
+    """First token of a request line, sanitised for use as a label
+    value (bounded cardinality: lowercase word chars, ≤16 chars,
+    anything else → "other")."""
+    tok = line.split(None, 1)[0] if line.strip() else "empty"
+    tok = tok.lower()
+    if len(tok) <= 16 and tok.replace("_", "").isalnum():
+        return tok
+    return "other"
+
+
+class NetMeter:
+    """(direction, verb) byte/frame counters on the metrics registry.
+
+    One meter per role (``server`` for :class:`LineServer` fronts,
+    ``client`` for :func:`request_lines` and the cluster client's
+    connections) so the two endpoints of an in-process topology never
+    collapse into one series.  Instrument handles are cached per key;
+    a missing telemetry plane (or ``registry=False``) disables the
+    meter rather than failing the I/O path.
+    """
+
+    def __init__(self, role: str = "server", registry=None):
+        self.role = role
+        self._registry = registry
+        self._enabled = registry is not False
+        self._counters: Dict[tuple, tuple] = {}
+        self._bound_to = None  # registry the cache was built against
+        self._lock = threading.Lock()
+
+    def count(
+        self, direction: str, verb: str, nbytes: int, frames: int = 1
+    ) -> None:
+        if not self._enabled:
+            return
+        try:
+            from ..telemetry.registry import get_registry
+
+            reg = (
+                self._registry if self._registry is not None
+                else get_registry()
+            )
+        except Exception:  # accounting must never fail a request
+            self._enabled = False
+            return
+        if reg is not self._bound_to:
+            # default registry swapped (test isolation): drop handles
+            # pinned to the old one instead of counting into the void
+            with self._lock:
+                if reg is not self._bound_to:
+                    self._counters = {}
+                    self._bound_to = reg
+        key = (direction, verb)
+        pair = self._counters.get(key)  # dict reads are GIL-atomic
+        if pair is None:
+            try:
+                with self._lock:
+                    pair = self._counters.get(key)
+                    if pair is None:
+                        labels = {
+                            "direction": direction, "verb": verb,
+                            "role": self.role,
+                        }
+                        pair = (
+                            reg.counter(
+                                "net_bytes_total", component="net",
+                                **labels,
+                            ),
+                            reg.counter(
+                                "net_frames_total", component="net",
+                                **labels,
+                            ),
+                        )
+                        self._counters[key] = pair
+            except Exception:  # accounting must never fail a request
+                self._enabled = False
+                return
+        pair[0].inc(nbytes)
+        pair[1].inc(frames)
+
+
+# the client-role meter request_lines (and ShardConnection) share
+_CLIENT_METER_LOCK = threading.Lock()
+_CLIENT_METER: Optional[NetMeter] = None
+
+
+def client_meter() -> NetMeter:
+    global _CLIENT_METER
+    with _CLIENT_METER_LOCK:
+        if _CLIENT_METER is None:
+            _CLIENT_METER = NetMeter(role="client")
+        return _CLIENT_METER
+
+
+class ConnStats:
+    """Per-connection wire ledger (updated only by the connection's
+    own handler thread; read by :meth:`LineServer.conn_table`)."""
+
+    __slots__ = (
+        "peer", "connected_at", "bytes_in", "bytes_out",
+        "frames_in", "frames_out", "last_verb",
+    )
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.connected_at = time.time()
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.frames_in = 0
+        self.frames_out = 0
+        self.last_verb = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "peer": self.peer,
+            "age_s": round(time.time() - self.connected_at, 3),
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "frames_in": self.frames_in,
+            "frames_out": self.frames_out,
+            "last_verb": self.last_verb,
+        }
 
 
 class LineServer:
@@ -50,9 +187,14 @@ class LineServer:
         name: str = "line-server",
         backlog: int = 16,
         max_line_bytes: int = 1 << 20,
+        registry=None,
     ):
         self.name = name
         self.max_line_bytes = int(max_line_bytes)
+        # wire accounting: process-wide counters + per-connection table
+        # (registry=False switches the counters off; the table stays)
+        self.meter = NetMeter(role="server", registry=registry)
+        self._conn_stats: Dict[socket.socket, ConnStats] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -72,6 +214,21 @@ class LineServer:
         ``SpanTracer.stack_count()``."""
         with self._conns_lock:
             return len(self._conns)
+
+    def conn_table(self) -> List[dict]:
+        """Live per-connection wire ledger — peer, age, bytes/frames
+        each way, last verb — the ``psctl conns`` answer."""
+        with self._conns_lock:
+            stats = list(self._conn_stats.values())
+        return [s.as_dict() for s in stats]
+
+    def _stats_for(self, conn: socket.socket) -> ConnStats:
+        st = self._conn_stats.get(conn)
+        if st is None:  # handler started before accept registered it
+            st = ConnStats("?")
+            with self._conns_lock:
+                st = self._conn_stats.setdefault(conn, st)
+        return st
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "LineServer":
@@ -154,7 +311,7 @@ class LineServer:
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
-                conn, _addr = self._sock.accept()
+                conn, addr = self._sock.accept()
             except OSError:
                 return  # listener closed
             try:
@@ -166,6 +323,9 @@ class LineServer:
                 pass
             with self._conns_lock:
                 self._conns.append(conn)
+                self._conn_stats.setdefault(
+                    conn, ConnStats(f"{addr[0]}:{addr[1]}")
+                )
                 self.connections_accepted += 1
                 # prune finished handlers so the tracking list stays
                 # bounded by LIVE connections, not total ever accepted
@@ -195,6 +355,7 @@ class LineServer:
                     self._conns.remove(conn)
                 except ValueError:
                     pass
+                self._conn_stats.pop(conn, None)
 
     # -- override points ---------------------------------------------------
     def handle_connection(self, conn: socket.socket) -> None:
@@ -202,8 +363,11 @@ class LineServer:
         requests, answer each with ``respond(line) + "\\n"`` in order.
         A request exceeding ``max_line_bytes`` with no newline gets one
         ``err bad-request`` line and the connection closed (the buffer
-        must stay bounded)."""
+        must stay bounded).  Bytes and frames are attributed per line
+        to the request's verb (wire accounting — see module
+        docstring)."""
         buf = b""
+        stats = self._stats_for(conn)
         while not self._stop.is_set():
             chunk = conn.recv(1 << 16)
             if not chunk:
@@ -217,9 +381,21 @@ class LineServer:
                 line = raw.decode("utf-8", "replace").strip()
                 if not line:
                     continue
+                verb = _safe_verb(line)
+                stats.last_verb = verb
+                stats.bytes_in += len(raw) + 1
+                stats.frames_in += 1
+                self.meter.count("in", verb, len(raw) + 1)
                 resp = self.respond(line)
                 if resp is not None:
-                    conn.sendall(resp.encode("utf-8") + b"\n")
+                    payload = resp.encode("utf-8") + b"\n"
+                    # ledger BEFORE the write: a client that has read
+                    # the response must never observe a table that
+                    # hasn't counted it yet
+                    stats.bytes_out += len(payload)
+                    stats.frames_out += 1
+                    self.meter.count("out", verb, len(payload))
+                    conn.sendall(payload)
 
     def respond(self, line: str) -> Optional[str]:
         """One response line per request line (no trailing newline;
@@ -239,9 +415,15 @@ def request_lines(
 ) -> List[str]:
     """Pipelined client helper: send every request line on ONE
     connection, then read exactly one response line per request (the
-    line-protocol ordering contract).  Returns the response lines."""
+    line-protocol ordering contract).  Returns the response lines.
+    Bytes/frames are counted into the client-role wire ledger
+    (``net_bytes_total{role="client"}``), attributed per request verb
+    — responses positionally, per the ordering contract."""
     reqs = [ln.strip() for ln in lines]
+    meter = client_meter()
     with socket.create_connection((host, port), timeout=timeout) as s:
+        for ln in reqs:
+            meter.count("out", _safe_verb(ln), len(ln) + 1)
         s.sendall(("\n".join(reqs) + "\n").encode("utf-8"))
         buf = b""
         out: List[str] = []
@@ -253,8 +435,19 @@ def request_lines(
                 )
             buf += chunk
             *got, buf = buf.split(b"\n")
-            out.extend(g.decode("utf-8", "replace") for g in got)
+            for g in got:
+                if len(out) < len(reqs):
+                    meter.count(
+                        "in", _safe_verb(reqs[len(out)]), len(g) + 1
+                    )
+                out.append(g.decode("utf-8", "replace"))
     return out[: len(reqs)]
 
 
-__all__ = ["LineServer", "request_lines"]
+__all__ = [
+    "ConnStats",
+    "LineServer",
+    "NetMeter",
+    "client_meter",
+    "request_lines",
+]

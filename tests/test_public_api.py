@@ -60,6 +60,12 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.telemetry.slo": [
         "SLOEngine", "SLOSpec", "default_slos", "pull_latency_slo",
         "serving_latency_slo", "staleness_slo", "recovery_time_slo"],
+    "flink_parameter_server_tpu.telemetry.profiler": [
+        "PhaseProfiler", "StackSampler", "PHASES", "get_profiler",
+        "set_profiler", "resolve_profiler"],
+    "flink_parameter_server_tpu.utils.net": [
+        "LineServer", "NetMeter", "ConnStats", "client_meter",
+        "request_lines"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",

@@ -25,11 +25,12 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Two further artifact shapes from the observability plane lint here
+Three further artifact shapes from the observability plane lint here
 too (docs/observability.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
     python tools/check_metric_lines.py --flightrec flightrec_stall.json
+    python tools/check_metric_lines.py --budget budget.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -37,8 +38,13 @@ numeric non-negative ``ts``, and a ``trace_id`` key in ``args``
 (``null`` allowed — the key records the decision); ``X`` events are
 timestamp-monotone.  ``--flightrec`` checks a flight-recorder dump:
 a JSON object with ``reason``/``pid``/``run_id``/``events``, every
-event carrying a numeric ``ts`` and ``kind``.  A mode flag applies to
-the paths that follow it.
+event carrying a numeric ``ts`` and ``kind``.  ``--budget`` checks a
+latency-budget artifact (telemetry/profiler.py
+``write_budget_artifact``): ts/run_id stamped, every budget carries a
+non-empty phase list with numeric ``p50_ms``/``pct``, and for any
+verb with full coverage the phase percentages sum to 100 ± 10 — the
+additivity contract the profiler's decomposition promises.  A mode
+flag applies to the paths that follow it.
 """
 from __future__ import annotations
 
@@ -52,7 +58,7 @@ from typing import Any, Iterable, List, Tuple
 # the HealthMonitor heartbeat component (resilience/health.py SERVING).
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
-     "serving_dispatch", "elastic", "slo"}
+     "serving_dispatch", "elastic", "slo", "profiler", "net"}
 )
 
 
@@ -181,6 +187,58 @@ def check_flightrec(doc: Any) -> List[str]:
     return bad
 
 
+def check_budget(doc: Any) -> List[str]:
+    """Lint a latency-budget artifact (telemetry/profiler.py
+    ``write_budget_artifact`` format)."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"budget document is {type(doc).__name__}, expected a "
+                f"JSON object"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        bad.append("missing/non-numeric 'ts'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    budgets = doc.get("budgets")
+    if not isinstance(budgets, dict) or not budgets:
+        bad.append("missing/empty 'budgets' object")
+        return bad
+    for verb, b in budgets.items():
+        if not isinstance(b, dict):
+            bad.append(f"budget {verb!r}: not an object")
+            continue
+        phases = b.get("phases")
+        if not isinstance(phases, list) or not phases:
+            bad.append(f"budget {verb!r}: missing/empty 'phases'")
+            continue
+        for p in phases:
+            if not isinstance(p, dict) or not isinstance(
+                p.get("phase"), str
+            ):
+                bad.append(f"budget {verb!r}: phase without a name")
+                continue
+            for field in ("p50_ms", "pct"):
+                v = p.get(field)
+                if not isinstance(v, (int, float)) or v < 0:
+                    bad.append(
+                        f"budget {verb!r} phase {p.get('phase')!r}: "
+                        f"missing/negative {field!r}"
+                    )
+        # additivity: with both endpoints instrumented the phase
+        # percentages must close the books on the round
+        if b.get("coverage") == "full" and b.get("round_ms"):
+            total = sum(
+                p.get("pct", 0) for p in phases
+                if isinstance(p.get("pct"), (int, float))
+            )
+            if not 90.0 <= total <= 110.0:
+                bad.append(
+                    f"budget {verb!r}: phase percentages sum to "
+                    f"{round(total, 1)} (full coverage requires "
+                    f"100 ± 10)"
+                )
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -201,6 +259,8 @@ def main(argv: List[str]) -> int:
             mode = "trace"
         elif a == "--flightrec":
             mode = "flightrec"
+        elif a == "--budget":
+            mode = "budget"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -210,15 +270,17 @@ def main(argv: List[str]) -> int:
             jobs.append((mode, a))
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
-              "[--trace|--flightrec|--lines] <file|-> ...",
+              "[--trace|--flightrec|--budget|--lines] <file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
-        if mode in ("trace", "flightrec"):
-            checker = (
-                check_trace_events if mode == "trace" else check_flightrec
-            )
+        if mode in ("trace", "flightrec", "budget"):
+            checker = {
+                "trace": check_trace_events,
+                "flightrec": check_flightrec,
+                "budget": check_budget,
+            }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:
                 failed = True

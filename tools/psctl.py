@@ -1,0 +1,383 @@
+#!/usr/bin/env python
+"""psctl — live introspection CLI for a running parameter-server
+cluster.
+
+`kubectl`-shaped operator verbs over the two live surfaces the runtime
+already exposes: the telemetry endpoint (``/metrics`` + the ``budget``/
+``conns`` JSON paths, telemetry/exporter.py) and the shard servers'
+debug verbs (``stats``/``conns``, cluster/shard.py).  Stdlib-only on
+purpose — it must start instantly on an operator box and never drag
+jax into a shell session.
+
+Usage::
+
+    psctl top    --metrics HOST:PORT [--interval 2] [--iterations 0]
+    psctl stats  --shards HOST:PORT[,HOST:PORT...]
+    psctl conns  --shards HOST:PORT[,...] | --metrics HOST:PORT
+    psctl budget --metrics HOST:PORT [--verb pull] [--json]
+
+``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
+``--interval`` seconds, derives rates from counter deltas (updates/sec,
+pulls/sec, wire bytes/sec each way) and shows the live gauges
+(staleness, queue depths, in-flight pulls) plus the hottest latency-
+budget phase.  ``--iterations N`` stops after N frames (0 = forever);
+``--raw`` skips the screen-clear escape (pipe/CI friendly).
+
+``stats`` asks each shard for its one-line JSON stats (rows, pulls,
+pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
+table row per shard.  ``conns`` renders each server's live connection
+ledger (peer, age, bytes/frames each way).  ``budget`` renders the
+per-phase latency budget (telemetry/profiler.py) — the table
+docs/perf_status.md cites; ``--json`` emits the raw artifact (lintable
+via ``tools/check_metric_lines.py --budget`` after stamping, or use
+the run-report JSON).
+
+Exit codes: 0 ok, 1 unreachable endpoint, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import socket
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+# -- transport (matches telemetry/exporter.py + utils/net.py idioms) ----------
+
+
+def scrape(host: str, port: int, path: str = "metrics",
+           timeout: float = 5.0) -> str:
+    """One-shot line-protocol scrape: send the bare path, read to EOF."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(path.strip().encode("utf-8") + b"\n")
+        chunks = []
+        while True:
+            c = s.recv(1 << 16)
+            if not c:
+                break
+            chunks.append(c)
+    return b"".join(chunks).decode("utf-8", "replace")
+
+
+def request_lines(host: str, port: int, lines: List[str],
+                  timeout: float = 5.0) -> List[str]:
+    """Line-protocol client: one response line per request line."""
+    reqs = [ln.strip() for ln in lines]
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(("\n".join(reqs) + "\n").encode("utf-8"))
+        buf = b""
+        out: List[str] = []
+        while len(out) < len(reqs):
+            chunk = s.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError(
+                    f"peer closed after {len(out)}/{len(reqs)} responses"
+                )
+            buf += chunk
+            *got, buf = buf.split(b"\n")
+            out.extend(g.decode("utf-8", "replace") for g in got)
+    return out[: len(reqs)]
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(f"{addr!r}: expected HOST:PORT")
+    return host, int(port)
+
+
+# -- Prometheus text parsing --------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> Dict[Tuple[str, tuple], float]:
+    """``{(name, sorted-label-items): value}`` over every sample line."""
+    out: Dict[Tuple[str, tuple], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = tuple(sorted(
+            (k, v.replace(r"\"", '"').replace(r"\\", "\\"))
+            for k, v in _LABEL_RE.findall(m.group("labels") or "")
+        ))
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue  # NaN markers etc. stay out of the rate math
+        out[(m.group("name"), labels)] = value
+    return out
+
+
+def _sum_named(samples: Dict[Tuple[str, tuple], float], name: str,
+               **want: str) -> float:
+    total = 0.0
+    for (n, labels), v in samples.items():
+        if n != name:
+            continue
+        d = dict(labels)
+        if all(d.get(k) == val for k, val in want.items()):
+            total += v
+    return total
+
+
+# -- the verbs ----------------------------------------------------------------
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def _render_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip()
+    ]
+    for r in rows:
+        lines.append(
+            "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+        )
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    host, port = parse_addr(args.metrics)
+    prev: Optional[Dict[Tuple[str, tuple], float]] = None
+    prev_t = 0.0
+    shown = 0
+    while True:
+        try:
+            samples = parse_prometheus(scrape(host, port, "metrics"))
+            budgets = json.loads(
+                scrape(host, port, "budget")
+            ).get("budgets", {})
+        except OSError as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        now = time.time()
+        dt = now - prev_t if prev is not None else None
+
+        def rate(name: str, **want) -> str:
+            if prev is None or not dt:
+                return "—"
+            d = (
+                _sum_named(samples, name, **want)
+                - _sum_named(prev, name, **want)
+            )
+            return f"{d / dt:,.0f}"
+
+        lines = [
+            f"psctl top — {host}:{port} — "
+            f"{time.strftime('%H:%M:%S', time.localtime(now))}",
+            "",
+            f"updates/sec   {rate('fps_train_events_total')}"
+            f"    rounds/sec  {rate('fps_cluster_worker_rounds_total')}",
+            f"pulls/sec     {rate('fps_cluster_pulls_total')}"
+            f"    pushes/sec  {rate('fps_cluster_pushes_total')}",
+            f"wire in/sec   "
+            f"{rate('fps_net_bytes_total', direction='in', role='server')}"
+            f" B    out/sec     "
+            f"{rate('fps_net_bytes_total', direction='out', role='server')}"
+            f" B",
+            f"staleness     "
+            f"{_sum_named(samples, 'fps_cluster_staleness_steps'):g}"
+            f"    queue depth "
+            f"{_sum_named(samples, 'fps_cluster_shard_queue_depth'):g}"
+            f"    inflight pulls "
+            f"{_sum_named(samples, 'fps_inflight_pulls'):g}",
+        ]
+        for verb in sorted(budgets):
+            b = budgets[verb]
+            if b.get("round_ms") and b.get("top_phase"):
+                lines.append(
+                    f"budget[{verb}]  round p50 {b['round_ms']} ms — "
+                    f"top: {b['top_phase']} ({b['top_pct']}%)"
+                )
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        prev, prev_t = samples, now
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_stats(args) -> int:
+    rows: List[List[str]] = []
+    for addr in args.shards.split(","):
+        host, port = parse_addr(addr.strip())
+        try:
+            resp = request_lines(host, port, ["stats"])[0]
+        except OSError as e:
+            print(f"psctl: {addr} unreachable: {e}", file=sys.stderr)
+            return 1
+        if not resp.startswith("ok "):
+            print(f"psctl: {addr}: {resp}", file=sys.stderr)
+            return 1
+        s = json.loads(resp[3:])
+        rows.append([
+            str(s.get("shard", "?")), addr.strip(),
+            str(s.get("rows", 0)), str(s.get("pulls", 0)),
+            str(s.get("pushes", 0)), str(s.get("restarts", 0)),
+            str(s.get("epoch", 0)), str(s.get("wal_records", 0)),
+            str(s.get("dedupe_pairs", 0)), str(s.get("frozen", 0)),
+            "yes" if s.get("alive") else "NO",
+        ])
+    print(_render_table(
+        ["shard", "addr", "rows", "pulls", "pushes", "restarts",
+         "epoch", "wal", "dedupe", "frozen", "alive"],
+        rows,
+    ))
+    return 0
+
+
+def cmd_conns(args) -> int:
+    tables: List[Tuple[str, List[dict]]] = []
+    if args.shards:
+        for addr in args.shards.split(","):
+            host, port = parse_addr(addr.strip())
+            try:
+                resp = request_lines(host, port, ["conns"])[0]
+            except OSError as e:
+                print(f"psctl: {addr} unreachable: {e}", file=sys.stderr)
+                return 1
+            if not resp.startswith("ok "):
+                print(f"psctl: {addr}: {resp}", file=sys.stderr)
+                return 1
+            tables.append((addr.strip(), json.loads(resp[3:])))
+    elif args.metrics:
+        host, port = parse_addr(args.metrics)
+        try:
+            doc = json.loads(scrape(host, port, "conns"))
+        except OSError as e:
+            print(f"psctl: {args.metrics} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        tables.append((args.metrics, doc.get("conns", [])))
+    else:
+        print("psctl conns: need --shards or --metrics", file=sys.stderr)
+        return 2
+    for addr, conns in tables:
+        print(f"{addr}: {len(conns)} connection(s)")
+        rows = [
+            [c.get("peer", "?"), f"{c.get('age_s', 0):.1f}s",
+             _fmt_bytes(c.get("bytes_in", 0)),
+             _fmt_bytes(c.get("bytes_out", 0)),
+             str(c.get("frames_in", 0)), str(c.get("frames_out", 0)),
+             c.get("last_verb", "")]
+            for c in conns
+        ]
+        if rows:
+            print(_render_table(
+                ["peer", "age", "bytes in", "bytes out", "frames in",
+                 "frames out", "last verb"],
+                rows,
+            ))
+    return 0
+
+
+def cmd_budget(args) -> int:
+    host, port = parse_addr(args.metrics)
+    try:
+        doc = json.loads(scrape(host, port, "budget"))
+    except OSError as e:
+        print(f"psctl: {args.metrics} unreachable: {e}", file=sys.stderr)
+        return 1
+    budgets = doc.get("budgets", {})
+    if args.verb:
+        budgets = {
+            v: b for v, b in budgets.items() if v == args.verb
+        }
+    if args.json:
+        print(json.dumps({"budgets": budgets,
+                          "run_id": doc.get("run_id")}, indent=2))
+        return 0
+    if not budgets:
+        print("psctl: no phase observations yet (is the profiler on "
+              "and traffic flowing?)")
+        return 0
+    for verb in sorted(budgets):
+        b = budgets[verb]
+        print(
+            f"{verb}: round p50 {b.get('round_ms')} ms over "
+            f"{b.get('rounds')} frames — top cost center: "
+            f"{b.get('top_phase')} ({b.get('top_pct')}%), "
+            f"coverage {b.get('coverage')}"
+        )
+        rows = [
+            [p["phase"], f"{p['p50_ms']:.4f}", f"{p['mean_ms']:.4f}",
+             f"{p['pct']:.1f}%", str(p["count"])]
+            for p in b.get("phases", [])
+        ]
+        print(_render_table(
+            ["phase", "p50 ms", "mean ms", "% round", "frames"], rows
+        ))
+        print()
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="psctl", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    top = sub.add_parser("top", help="live top-style view over /metrics")
+    top.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    top.add_argument("--interval", type=float, default=2.0)
+    top.add_argument("--iterations", type=int, default=0,
+                     help="stop after N frames (0 = forever)")
+    top.add_argument("--raw", action="store_true",
+                     help="no screen clear (pipe/CI friendly)")
+    top.set_defaults(fn=cmd_top)
+
+    st = sub.add_parser("stats", help="per-shard stats table")
+    st.add_argument("--shards", required=True,
+                    metavar="HOST:PORT[,HOST:PORT...]")
+    st.set_defaults(fn=cmd_stats)
+
+    cn = sub.add_parser("conns", help="live connection ledgers")
+    cn.add_argument("--shards", metavar="HOST:PORT[,...]")
+    cn.add_argument("--metrics", metavar="HOST:PORT")
+    cn.set_defaults(fn=cmd_conns)
+
+    bu = sub.add_parser("budget", help="latency-budget phase table")
+    bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    bu.add_argument("--verb", default=None,
+                    help="only this verb's budget (default: all)")
+    bu.add_argument("--json", action="store_true")
+    bu.set_defaults(fn=cmd_budget)
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except ValueError as e:
+        print(f"psctl: {e}", file=sys.stderr)
+        return 2
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
