@@ -260,8 +260,13 @@ class ParamShard:
         if self._wal is not None and self._wal.last_step_logged is not None:
             # fresh process over an existing WAL dir: the restart path
             self._replay()
-        # unified plane: per-shard instruments under component=cluster
+        # unified plane: per-shard instruments under component=cluster.
+        # The request-depth counter is bumped by EVERY connection's
+        # handler thread; += on an attribute is not atomic, so it gets
+        # its own tiny lock (fpsanalyze S001) — never nested with
+        # self._lock, so no ordering edge
         self._active_requests = 0
+        self._depth_lock = threading.Lock()
         if registry is not False:
             from ..telemetry.registry import get_registry
 
@@ -285,6 +290,7 @@ class ParamShard:
             self._c_pulls = self._c_pushes = self._c_restarts = None
 
     # -- construction / recovery -------------------------------------------
+    # fpsanalyze: allow[S001] _build writes run under self._lock at every call site (__init__ construction, restart) — the lock is the caller's
     def _build(self) -> None:
         """(Re)materialise the local slice from the deterministic init:
         local row j = init(owned[j]) — observationally the global
@@ -545,16 +551,22 @@ class ParamShard:
 
     def flush(self) -> dict:
         """Make the log durable (fsync) and ack the counters — the wire
-        protocol's explicit durability point."""
+        protocol's explicit durability point.
+
+        The fsync runs OUTSIDE the shard lock (fpsanalyze B001 fix):
+        the WAL serializes appends/syncs internally, so holding the
+        shard lock across the disk wait only stalled every concurrent
+        pull/push behind the platter.  Every push appended before this
+        call's lock window is covered by the sync; a push that slips in
+        after the release is made durable EARLY — never lost."""
         with self._lock:
-            wal_records = 0
-            if self._wal is not None:
-                self._wal.sync()
-                wal_records = self._wal.records_appended
-            return {
-                "pushes": self.pushes_applied,
-                "wal_records": wal_records,
-            }
+            wal = self._wal
+            pushes = self.pushes_applied
+        wal_records = 0
+        if wal is not None:
+            wal.sync()
+            wal_records = wal.records_appended
+        return {"pushes": pushes, "wal_records": wal_records}
 
     def values(self) -> np.ndarray:
         """The local slice, rows ordered by :attr:`owned` (ascending
@@ -858,13 +870,15 @@ class ShardServer(LineServer):
 
     # -- the protocol ------------------------------------------------------
     def respond(self, line: str) -> str:
-        self.shard._active_requests += 1
+        with self.shard._depth_lock:
+            self.shard._active_requests += 1
         verb = line.split(None, 1)[0].lower() if line else ""
         t0 = time.perf_counter()
         try:
             return self._respond_supervised(line)
         finally:
-            self.shard._active_requests -= 1
+            with self.shard._depth_lock:
+                self.shard._active_requests -= 1
             if verb in ("pull", "push"):
                 # the whole-request server wall: what the client's RTT
                 # minus this equals is the wire cost (profiler budget)

@@ -53,6 +53,7 @@ def _build() -> str:
 def get_lib() -> ctypes.CDLL:
     """Load (building if needed) the native library."""
     global _lib
+    # fpsanalyze: allow[B001] build-once double-checked lock: every caller MUST wait for the one-time g++ build — blocking here is the contract
     with _lib_lock:
         if _lib is not None:
             return _lib

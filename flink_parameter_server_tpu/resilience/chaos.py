@@ -321,6 +321,7 @@ class ChaosLineServer:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # fpsanalyze: allow[S001] ONE serve thread owns these counters — connections are accepted and served sequentially by design (the chaos producer replays a script)
     def _serve(self) -> None:
         while not self._stop.is_set() and self._cursor < len(self.lines):
             try:

@@ -128,6 +128,7 @@ class UpdateWAL:
         ]
         return [os.path.join(self.directory, n) for n in sorted(names)]
 
+    # fpsanalyze: allow[S001] _open_segment only runs under self._lock (append holds it); the lock is the caller's
     def _open_segment(self) -> None:
         path = os.path.join(
             self.directory, f"wal-{self._next_seq:016d}.seg"
@@ -182,6 +183,7 @@ class UpdateWAL:
         if n_steps < 1:
             raise ValueError(f"n_steps={n_steps}: must be >= 1")
         blob = pickle.dumps(payload, protocol=4)
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             end = start_step + n_steps
             if end <= self._last_end:
@@ -232,6 +234,7 @@ class UpdateWAL:
 
     def sync(self) -> None:
         """Force the pending appends durable (explicit-save sibling)."""
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
@@ -249,6 +252,7 @@ class UpdateWAL:
         """All intact records with ``end_step > after_step``, in order —
         the tail to feed back through the training step after restoring
         the checkpoint taken at ``after_step``."""
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             if self._fh is not None:  # replay must see the full tail
                 self._fh.flush()
@@ -309,6 +313,7 @@ class UpdateWAL:
         its covered records cheaply skipped at replay by ``after_step``.
         Returns the number of segments removed."""
         removed = 0
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             current = self._fh.name if self._fh is not None else None
             if self._fh is not None:
@@ -346,6 +351,7 @@ class UpdateWAL:
         replaying them would re-diverge deterministically).  Returns the
         number of records dropped."""
         dropped = 0
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
@@ -398,6 +404,7 @@ class UpdateWAL:
             return self._total_bytes_locked()
 
     def close(self) -> None:
+        # fpsanalyze: allow[B001] the WAL lock IS the durability serialization point — fsync/flush must be ordered with appends under it
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()

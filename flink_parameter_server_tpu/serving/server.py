@@ -231,6 +231,7 @@ class ServingService:
                 # with it dead, every later query hangs to its timeout
                 # while the trainer keeps publishing to nobody.  Fail
                 # the batch's futures, count it, keep serving.
+                # fpsanalyze: allow[S001] the ONE dispatch thread is the sole writer; readers are monitoring-only
                 self.dispatch_errors += 1
                 for p in batch:
                     if not p.future.done():

@@ -1,0 +1,13 @@
+"""Seeded bug: a blocking socket recv inside a held lock (B001)."""
+import socket
+import threading
+
+
+class Fetcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sock = socket.socket()
+
+    def fetch(self):
+        with self._lock:
+            return self._sock.recv(1024)

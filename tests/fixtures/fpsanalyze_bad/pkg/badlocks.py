@@ -1,0 +1,19 @@
+"""Seeded bug: a textbook AB/BA lock-order cycle (fpsanalyze L001)."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.value = 0
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                self.value += 1
+
+    def backward(self):
+        with self._block:
+            with self._alock:
+                self.value -= 1

@@ -1,0 +1,7 @@
+"""Seeded bug: a metric with an unknown component, absent from the
+docs catalog (D002)."""
+
+
+def register(reg):
+    reg.counter("bogus_metric_total", component="bogus")
+    reg.counter("good_metric_total", component="train")

@@ -1,0 +1,11 @@
+"""Seeded bug: unguarded cross-thread attribute mutation (S001)."""
+import threading
+
+
+class Tally:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        self.count += 1

@@ -1,0 +1,14 @@
+"""Seeded bug: a client emits a verb no server handles (D001)."""
+
+
+class MiniServer:
+    def _execute(self, line):
+        toks = line.split()
+        cmd = toks[0]
+        if cmd == "pull":
+            return "ok"
+        raise ValueError(cmd)
+
+
+def emit(conn):
+    return conn.request_many(["pull 1,2", "frobnicate 3"])

@@ -1,0 +1,36 @@
+"""Clean fixture: disciplined locking, guarded cross-thread state,
+conforming verbs and metrics — every fpsanalyze rule must stay quiet
+here."""
+import threading
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        with self._lock:
+            self.count += 1
+
+    def read(self):
+        with self._lock:
+            return self.count
+
+
+class MiniServer:
+    def _execute(self, line):
+        toks = line.split()
+        cmd = toks[0]
+        if cmd == "ping":
+            return "ok pong"
+        raise ValueError(cmd)
+
+
+def emit(conn):
+    return conn.request_many(["ping 1"])
+
+
+def register(reg):
+    reg.counter("clean_metric_total", component="train")

@@ -1,0 +1,457 @@
+"""fpsanalyze + lockwitness — the concurrency/drift analysis suite.
+
+Three layers, mirroring the lint-test pattern of
+``tools/check_metric_lines.py``:
+
+  * **seeded-bug fixtures** (tests/fixtures/fpsanalyze_bad): one
+    deliberate bug per rule family — a lock cycle, a blocking recv
+    under a lock, an unguarded cross-thread attr, a phantom wire verb,
+    an uncatalogued metric — each rule must fire ON its fixture and
+    stay silent on the clean twin;
+  * **the real tree**: ``run_analysis`` over the repo must report zero
+    non-baselined findings, every baseline entry justified — the
+    tier-1 regression guard the multiprocess rework will lean on;
+  * **the runtime oracle** (telemetry/lockwitness.py): unit inversion
+    tests plus a live 2-shard cluster workload run under
+    ``lockwitness.capture()`` with zero lock-order inversions — the
+    dynamic cross-check of the static L001 report.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT) if ROOT not in sys.path else None
+
+from tools.fpsanalyze import run_analysis  # noqa: E402
+from tools.fpsanalyze.cli import AnalysisResult  # noqa: E402
+from tools.fpsanalyze.findings import Baseline, BaselineError  # noqa: E402
+from tools.fpsanalyze.rules_drift import (  # noqa: E402
+    DriftConfig,
+    WireSurface,
+)
+
+pytestmark = pytest.mark.analysis
+
+FIX_BAD = os.path.join(ROOT, "tests", "fixtures", "fpsanalyze_bad")
+FIX_CLEAN = os.path.join(ROOT, "tests", "fixtures", "fpsanalyze_clean")
+
+
+def _fixture_drift() -> DriftConfig:
+    return DriftConfig(
+        surfaces=[WireSurface(
+            "shard", ("pkg/badverbs.py", "_execute"),
+            ["pkg/badverbs.py"], ("docs.md", "wire-verbs shard"),
+        )],
+        metric_doc_files=["docs.md"],
+        catalog_doc_files=["docs.md"],
+        known_components=frozenset({"train"}),
+        metric_scan_prefixes=["pkg/"],
+    )
+
+
+def _clean_drift() -> DriftConfig:
+    return DriftConfig(
+        surfaces=[WireSurface(
+            "shard", ("pkg/good.py", "_execute"),
+            ["pkg/good.py"], ("docs.md", "wire-verbs shard"),
+        )],
+        metric_doc_files=["docs.md"],
+        catalog_doc_files=["docs.md"],
+        known_components=frozenset({"train"}),
+        metric_scan_prefixes=["pkg/"],
+    )
+
+
+# -- the seeded-bug fixture package -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bad_result() -> AnalysisResult:
+    return run_analysis(
+        FIX_BAD, scan=("pkg",), baseline_path=None,
+        drift=_fixture_drift(),
+    )
+
+
+class TestSeededFixtures:
+    def test_lock_cycle_fires_on_its_fixture(self, bad_result):
+        hits = [f for f in bad_result.findings if f.rule == "L001"]
+        assert len(hits) == 1, hits
+        assert hits[0].file == "pkg/badlocks.py"
+        assert "_alock" in hits[0].message
+        assert "_block" in hits[0].message
+
+    def test_blocking_under_lock_fires_with_exact_line(
+        self, bad_result
+    ):
+        hits = [f for f in bad_result.findings if f.rule == "B001"]
+        assert len(hits) == 1, hits
+        f = hits[0]
+        assert (f.file, f.line) == ("pkg/badblocking.py", 13)
+        assert "recv" in f.message
+
+    def test_unguarded_shared_fires_with_exact_line(self, bad_result):
+        hits = [f for f in bad_result.findings if f.rule == "S001"]
+        assert len(hits) == 1, hits
+        f = hits[0]
+        assert (f.file, f.line) == ("pkg/badshared.py", 11)
+        assert "count" in f.message
+
+    def test_phantom_verb_fires(self, bad_result):
+        hits = [f for f in bad_result.findings if f.rule == "D001"]
+        assert len(hits) == 1, hits
+        f = hits[0]
+        assert f.file == "pkg/badverbs.py"
+        assert "frobnicate" in f.message
+
+    def test_metric_drift_fires(self, bad_result):
+        hits = sorted(
+            f.key for f in bad_result.findings if f.rule == "D002"
+        )
+        # the bogus metric trips BOTH metric checks: unknown component
+        # and absent from the catalog; the good one trips neither
+        assert any("unknown-component:bogus" in k for k in hits), hits
+        assert any(
+            k.endswith("uncatalogued:bogus_metric_total") for k in hits
+        ), hits
+        assert not any("good_metric_total" in k for k in hits)
+
+    def test_exactly_the_five_planted_families(self, bad_result):
+        by_rule = {}
+        for f in bad_result.findings:
+            by_rule.setdefault(f.rule, []).append(f)
+        assert sorted(by_rule) == [
+            "B001", "D001", "D002", "L001", "S001"
+        ]
+
+    def test_clean_package_is_silent(self):
+        res = run_analysis(
+            FIX_CLEAN, scan=("pkg",), baseline_path=None,
+            drift=_clean_drift(),
+        )
+        assert res.findings == [], [str(f) for f in res.findings]
+
+
+class TestEscapeHatchAndBaseline:
+    def test_allow_comment_needs_justification(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "import threading\n"
+            "import socket\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._sock = socket.socket()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            # fpsanalyze: allow[B001]\n"
+            "            self._sock.recv(1)\n"
+        )
+        res = run_analysis(
+            str(tmp_path), scan=("pkg",), baseline_path=None,
+            drift=None,
+        )
+        assert any(
+            "no justification" in f.message for f in res.findings
+        ), [str(f) for f in res.findings]
+
+    def test_allow_comment_with_justification_suppresses(
+        self, tmp_path
+    ):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "m.py").write_text(
+            "import threading\n"
+            "import socket\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._sock = socket.socket()\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            # fpsanalyze: allow[B001] handshake must "
+            "serialize\n"
+            "            self._sock.recv(1)\n"
+        )
+        res = run_analysis(
+            str(tmp_path), scan=("pkg",), baseline_path=None,
+            drift=None,
+        )
+        assert res.findings == [], [str(f) for f in res.findings]
+
+    def test_baseline_requires_justification(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({
+            "version": 1,
+            "entries": [{"key": "B001:x:y:z", "justification": ""}],
+        }))
+        with pytest.raises(BaselineError):
+            Baseline.load(str(p))
+
+    def test_baseline_suppresses_and_reports_stale(self, tmp_path):
+        res = run_analysis(
+            FIX_BAD, scan=("pkg",), baseline_path=None,
+            drift=_fixture_drift(),
+        )
+        keys = [f.key for f in res.findings]
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({
+            "version": 1,
+            "entries": (
+                [{"key": k, "justification": "accepted for the test"}
+                 for k in keys]
+                + [{"key": "L001:gone.py:fixed",
+                    "justification": "was fixed long ago"}]
+            ),
+        }))
+        res2 = run_analysis(
+            FIX_BAD, scan=("pkg",), baseline_path=str(p),
+            drift=_fixture_drift(),
+        )
+        assert res2.open_findings == []
+        assert all(f.baselined for f in res2.findings)
+        assert res2.stale_baseline == ["L001:gone.py:fixed"]
+
+
+# -- the real tree ------------------------------------------------------------
+
+
+class TestRealTree:
+    def test_zero_non_baselined_findings(self):
+        res = run_analysis(ROOT)
+        assert res.open_findings == [], (
+            "\n".join(str(f) for f in res.open_findings)
+        )
+        assert res.stale_baseline == [], res.stale_baseline
+
+    def test_every_baseline_entry_justified(self):
+        # Baseline.load raises on blank justifications; also pin that
+        # each committed entry's key still matches a live finding
+        bl = Baseline.load(
+            os.path.join(ROOT, "tools", "fpsanalyze", "baseline.json")
+        )
+        assert bl.entries, "baseline exists and is non-trivial"
+        for key, just in bl.entries.items():
+            assert len(just) > 20, (key, just)
+
+    def test_wire_verbs_fully_reconciled(self):
+        """The live shard verb set is exactly what docs/cluster.md
+        documents — including the migration xfer/load family and the
+        psctl conns verb (the PR-8 drift fix)."""
+        from tools.fpsanalyze.astindex import Index
+        from tools.fpsanalyze.cli import _collect_files
+        from tools.fpsanalyze.rules_drift import (
+            _documented_verbs,
+            _handled_verbs,
+        )
+
+        files = _collect_files(ROOT, ("flink_parameter_server_tpu",))
+        index = Index.build(ROOT, files)
+        handled, _ = _handled_verbs(
+            index, "flink_parameter_server_tpu/cluster/shard.py",
+            "_execute",
+        )
+        documented = _documented_verbs(
+            ROOT, "docs/cluster.md", "wire-verbs shard"
+        )
+        assert handled == {
+            "pull", "push", "xfer", "load", "flush", "stats", "conns",
+        }
+        assert documented == handled
+
+    def test_cli_json_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.fpsanalyze", "--json"],
+            cwd=ROOT, capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["open"] == []
+        assert doc["files_scanned"] > 50
+
+    def test_analysis_marker_registered(self):
+        import configparser  # noqa: F401 — stdlib only, no tomllib dep games
+
+        with open(os.path.join(ROOT, "pyproject.toml")) as f:
+            text = f.read()
+        assert "analysis:" in text
+
+
+# -- the runtime lock-order witness -------------------------------------------
+
+
+from flink_parameter_server_tpu.telemetry import lockwitness  # noqa: E402
+
+
+class TestLockWitness:
+    def test_inversion_recorded(self):
+        w = lockwitness.LockWitness()
+        a = w.wrap(threading.Lock(), "A")
+        b = w.wrap(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert len(w.inversions) == 1
+        inv = w.inversions[0]
+        assert (inv["acquiring"], inv["holding"]) == ("A", "B")
+
+    def test_strict_mode_raises_and_releases(self):
+        w = lockwitness.LockWitness(raise_on_inversion=True)
+        a = w.wrap(threading.Lock(), "A")
+        b = w.wrap(threading.Lock(), "B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockwitness.LockInversion):
+            with b:
+                with a:
+                    pass
+        # the inner lock was released before the raise: re-acquirable
+        assert a.acquire(blocking=False)
+        a.release()
+
+    def test_consistent_order_is_clean(self):
+        w = lockwitness.LockWitness()
+        a = w.wrap(threading.Lock(), "A")
+        b = w.wrap(threading.Lock(), "B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert w.inversions == []
+        assert w.edges() == {"A": {"B"}}
+
+    def test_rlock_reentrancy_no_false_inversion(self):
+        w = lockwitness.LockWitness()
+        r = w.wrap(threading.RLock(), "R")
+        b = w.wrap(threading.Lock(), "B")
+        with r:
+            with r:  # re-entrant
+                with b:
+                    pass
+        with r:
+            pass
+        assert w.inversions == []
+
+    def test_capture_patches_and_restores(self):
+        real = threading.Lock
+        with lockwitness.capture(include=("tests.",)) as w:
+            assert threading.Lock is not real
+            # created from THIS module (not under include): stays real
+            lk = threading.Lock()
+            assert not isinstance(lk, lockwitness.WitnessedLock)
+        assert threading.Lock is real
+        assert w.inversions == []
+
+    def test_condition_protocol_delegation(self):
+        w = lockwitness.LockWitness()
+        r = w.wrap(threading.RLock(), "R")
+        cond = threading.Condition(r)
+        fired = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                fired.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        t.join(timeout=5)
+        assert fired == [1]
+        assert w.inversions == []
+
+
+@pytest.mark.cluster
+class TestWitnessedClusterOracle:
+    def test_two_shard_traffic_zero_inversions(self, tmp_path):
+        """The tier-1 concurrency oracle: a live WAL-backed 2-shard
+        cluster — concurrent pulls/pushes from two client threads,
+        plus flush/stats and a crash+restart — under the lock-order
+        witness.  Zero inversions = the dynamic cross-check of the
+        static L001 report's empty cycle set."""
+        from flink_parameter_server_tpu.cluster.client import (
+            ClusterClient,
+        )
+        from flink_parameter_server_tpu.cluster.partition import (
+            RangePartitioner,
+        )
+        from flink_parameter_server_tpu.cluster.shard import (
+            ParamShard,
+            ShardServer,
+        )
+
+        def init(ids):
+            import jax.numpy as jnp
+
+            return (
+                jnp.asarray(ids, jnp.float32)[:, None]
+                * jnp.ones((1, 4), jnp.float32)
+            )
+
+        with lockwitness.capture() as w:
+            part = RangePartitioner(64, 2)
+            shards = [
+                ParamShard(
+                    s, part, (4,), init_fn=init,
+                    wal_dir=str(tmp_path / f"wal{s}"),
+                )
+                for s in range(2)
+            ]
+            servers = [
+                ShardServer(sh, supervised=True).start()
+                for sh in shards
+            ]
+            addrs = [(srv.host, srv.port) for srv in servers]
+            errors = []
+
+            def traffic(seed):
+                try:
+                    client = ClusterClient(
+                        addrs, part, (4,), registry=False
+                    )
+                    rng = np.random.default_rng(seed)
+                    for _ in range(10):
+                        ids = rng.integers(0, 64, size=8)
+                        client.pull_batch(ids)
+                        client.push_batch(
+                            ids, np.ones((8, 4), np.float32)
+                        )
+                    client.flush()
+                    client.shard_stats()
+                    client.close()
+                except Exception as e:  # pragma: no cover - surfaced
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=traffic, args=(s,))
+                for s in range(2)
+            ]
+            for t in threads:
+                t.start()
+            # concurrent supervised crash+restart exercises the
+            # restart path's locking while traffic flows
+            shards[0].crash()
+            for t in threads:
+                t.join(timeout=60)
+            for srv in servers:
+                srv.stop()
+            for sh in shards:
+                sh.close()
+        assert errors == [], errors
+        assert w.acquisitions > 0, "the witness saw no package locks"
+        assert w.inversions == [], w.inversions

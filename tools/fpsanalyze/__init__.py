@@ -1,0 +1,42 @@
+"""fpsanalyze — the project-native concurrency & drift analyzer.
+
+Stdlib-``ast`` static analysis tuned to THIS codebase's idioms (the
+``with self._lock:`` regions, ``LineServer`` handler threads, the
+newline-delimited wire verbs, the ``MetricsRegistry`` instrument
+registrations) rather than a generic linter's.  Four rule families:
+
+  * ``L001`` lock-order-cycle — per-class/module lock-acquisition graph
+    (direct nesting + intra-package call-graph closure); a cycle is a
+    potential deadlock.
+  * ``B001`` blocking-under-lock — socket send/recv/accept/connect,
+    fsync/file-flush/WAL sync, subprocess, sleep, and untimed Queue
+    get/put reached directly or via ONE call hop inside a held-lock
+    region.
+  * ``S001`` unguarded-shared-state — attributes mutated from
+    thread-entry functions (``threading.Thread(target=…)`` targets,
+    ``LineServer`` handlers, poll loops) without a lock, or assigned
+    both from thread context and other methods with no common lock.
+  * ``D001``/``D002`` drift — wire-verb conformance (shard/serving
+    handlers vs client emitters vs the marked doc blocks) and
+    metric-catalog conformance (registrations vs the docs catalog and
+    ``tools/check_metric_lines.py`` KNOWN_COMPONENTS).
+
+Findings carry a rule id + ``file:line`` and a line-number-free stable
+``key``; accepted findings live in ``tools/fpsanalyze/baseline.json``
+(every entry MUST carry a justification).  An inline escape hatch
+``# fpsanalyze: allow[RULE] <justification>`` suppresses a finding at
+its line, its enclosing ``with`` line, or its ``def`` line — a bare
+allow with no justification is itself a finding.  Run::
+
+    python -m tools.fpsanalyze            # human output, exit 1 on drift
+    python -m tools.fpsanalyze --json     # machine findings
+
+The runtime companion is ``flink_parameter_server_tpu/telemetry/
+lockwitness.py`` — a dynamic lock-order witness the tier-1 concurrency
+tests run under, cross-checking the static cycle report with a live
+oracle.  Full rule catalog + policy: docs/static_analysis.md.
+"""
+from .cli import main, run_analysis  # noqa: F401
+from .findings import Finding  # noqa: F401
+
+__all__ = ["main", "run_analysis", "Finding"]

@@ -1,0 +1,555 @@
+"""AST index over the scanned tree — the shared substrate every rule
+reads.
+
+One parse per file, one walk per function.  The index records exactly
+the shapes the rules need and nothing more:
+
+  * **lock regions** — ``with <expr>:`` where the expression's terminal
+    name contains ``lock`` (the repo-wide naming convention:
+    ``self._lock``, ``self._conns_lock``, ``rej_lock``,
+    ``_CLIENT_METER_LOCK``).  A ``self.X`` lock is identified at CLASS
+    level (``pkg.mod.Cls.X``) — every instance of the class shares the
+    identity, the standard static approximation and exactly the
+    identity the runtime witness (telemetry/lockwitness.py) derives
+    from the creation site.
+  * **calls** — every call site with its held-lock stack and a
+    resolution hint (``self.m()``, bare ``f()``, ``recv.m()``).
+  * **attribute writes** — assignments/aug-assignments whose target
+    chain roots at ``self``, with the held-lock stack.
+  * **thread entry points** — functions passed as
+    ``threading.Thread(target=…)`` plus the ``respond`` /
+    ``handle_connection`` overrides of ``LineServer`` descendants
+    (each connection gets a handler thread).
+  * **allow comments** — the ``# fpsanalyze: allow[RULE] why`` escape
+    hatch, per line.
+
+Resolution is deliberately conservative: ``self.m()`` resolves through
+the class's in-package base chain, bare calls through nested/module
+scope, and ``self.attr.m()`` through a best-effort attr→class map
+built from ``__init__`` assignments and parameter annotations.
+Anything else stays unresolved — a rule never guesses.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_ALLOW_RE = re.compile(
+    r"#\s*fpsanalyze:\s*allow\[([A-Za-z0-9_,-]+)\]\s*(.*)$"
+)
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted text of a Name/Attribute chain (``self.shard._lock``);
+    None for anything fancier (calls, subscripts)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lockish(name: str) -> bool:
+    return "lock" in name.lower()
+
+
+@dataclasses.dataclass
+class Acquire:
+    """One ``with <lock>:`` entry: the lock id and the ids already
+    held at that point (innermost last)."""
+
+    lock: str
+    held: Tuple[str, ...]
+    lineno: int
+    with_lineno: int  # line of the with-statement (allow-comment anchor)
+
+
+@dataclasses.dataclass
+class CallSite:
+    kind: str  # "self" | "local" | "attr" | "name"
+    name: str  # called attribute/function name
+    recv: Optional[str]  # receiver chain for kind="attr" ("self.shard")
+    held: Tuple[str, ...]
+    lineno: int
+    region_lineno: Optional[int]  # innermost enclosing with-lock line
+    keywords: Tuple[str, ...]  # keyword-arg names present
+    nargs: int
+
+
+@dataclasses.dataclass
+class AttrWrite:
+    attr: str  # terminal attribute name
+    chain: str  # full dotted chain ("self.shard._active_requests")
+    aug: bool
+    held: Tuple[str, ...]
+    lineno: int
+    region_lineno: Optional[int]
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str
+    qualname: str  # Cls.meth | func | outer.<locals>.inner
+    name: str
+    cls: Optional[str]
+    file: str  # root-relative path
+    lineno: int
+    acquires: List[Acquire] = dataclasses.field(default_factory=list)
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    writes: List[AttrWrite] = dataclasses.field(default_factory=list)
+    thread_targets: List[Tuple[str, str, Optional[str]]] = (
+        dataclasses.field(default_factory=list)
+    )  # (kind, name, recv) refs passed as Thread(target=...)
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.qualname)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    bases: List[str]
+    methods: Set[str] = dataclasses.field(default_factory=set)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    module: str  # dotted name relative to the scan root
+    file: str  # root-relative path
+    tree: ast.Module
+    module_locks: Set[str] = dataclasses.field(default_factory=set)
+    allows: Dict[int, Tuple[Tuple[str, ...], str]] = (
+        dataclasses.field(default_factory=dict)
+    )  # lineno -> (rule ids, justification)
+    string_constants: Set[str] = dataclasses.field(default_factory=set)
+
+
+class _FuncScanner:
+    """Walks ONE function body tracking the held-lock stack.  Nested
+    function definitions are boundaries — they are scanned as their own
+    FuncInfo (a closure runs when called, often on another thread, not
+    where it is defined)."""
+
+    def __init__(self, index: "Index", minfo: ModuleInfo,
+                 finfo: FuncInfo):
+        self.index = index
+        self.minfo = minfo
+        self.f = finfo
+
+    def lock_id(self, expr: ast.AST) -> Optional[str]:
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        terminal = chain.split(".")[-1]
+        if not _is_lockish(terminal):
+            return None
+        root = chain.split(".")[0]
+        if root == "self" and self.f.cls:
+            return f"{self.minfo.module}.{self.f.cls}.{chain[5:]}"
+        if "." not in chain:
+            if chain in self.minfo.module_locks:
+                return f"{self.minfo.module}.{chain}"
+            return (
+                f"{self.minfo.module}.{self.f.qualname}.<local>.{chain}"
+            )
+        return f"{self.minfo.module}.{self.f.qualname}.<expr>.{chain}"
+
+    def scan(self, fnode: ast.AST) -> None:
+        for stmt in fnode.body:
+            self._visit(stmt, (), None)
+
+    # -- walking -----------------------------------------------------------
+    def _visit(self, node: ast.AST, held: Tuple[str, ...],
+               region: Optional[int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            return  # separate scope; nested defs indexed on their own
+        if isinstance(node, ast.With):
+            new_held = held
+            new_region = region
+            for item in node.items:
+                self._visit(item.context_expr, new_held, new_region)
+                lid = self.lock_id(item.context_expr)
+                if lid is not None:
+                    self.f.acquires.append(Acquire(
+                        lid, new_held, item.context_expr.lineno,
+                        node.lineno,
+                    ))
+                    new_held = new_held + (lid,)
+                    new_region = node.lineno
+            for stmt in node.body:
+                self._visit(stmt, new_held, new_region)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, held, region)
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                for e in elts:
+                    chain = (
+                        attr_chain(e) if isinstance(e, ast.Attribute)
+                        else None
+                    )
+                    if chain and chain.startswith("self."):
+                        self.f.writes.append(AttrWrite(
+                            chain.split(".")[-1], chain,
+                            isinstance(node, ast.AugAssign), held,
+                            e.lineno, region,
+                        ))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held, region)
+
+    def _call_ref(self, func: ast.AST):
+        """(kind, name, recv) hint for a callable expression."""
+        if isinstance(func, ast.Name):
+            return ("local", func.id, None)
+        if isinstance(func, ast.Attribute):
+            recv = attr_chain(func.value)
+            if recv == "self":
+                return ("self", func.attr, None)
+            return ("attr", func.attr, recv)
+        return None
+
+    def _record_call(self, node: ast.Call, held: Tuple[str, ...],
+                     region: Optional[int]) -> None:
+        ref = self._call_ref(node.func)
+        if ref is not None:
+            kind, name, recv = ref
+            self.f.calls.append(CallSite(
+                kind, name, recv, held, node.lineno, region,
+                tuple(k.arg for k in node.keywords if k.arg),
+                len(node.args),
+            ))
+            # threading.Thread(target=...): record the target ref
+            chain = attr_chain(node.func) or ""
+            if name == "Thread" or chain.endswith("threading.Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tref = self._call_ref(kw.value) or (
+                            ("local", kw.value.id, None)
+                            if isinstance(kw.value, ast.Name) else None
+                        )
+                        if tref is not None:
+                            self.f.thread_targets.append(tref)
+
+
+class Index:
+    """The whole scanned tree, queryable."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.classes: Dict[str, List[ClassInfo]] = {}
+        self.attr_types: Dict[Tuple[str, str, str], str] = {}
+        self._locks_closure_memo: Dict[Tuple[str, str],
+                                       Set[str]] = {}
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def build(cls, root: str, rel_files: Sequence[str]) -> "Index":
+        idx = cls()
+        for rel in rel_files:
+            idx._add_file(root, rel)
+        idx._infer_attr_types()
+        return idx
+
+    def _module_name(self, rel: str) -> str:
+        return rel[:-3].replace(os.sep, ".").replace("/", ".")
+
+    def _add_file(self, root: str, rel: str) -> None:
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError:
+            return  # not this tool's job to report
+        minfo = ModuleInfo(self._module_name(rel), rel, tree)
+        for i, line in enumerate(source.splitlines(), 1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                minfo.allows[i] = (rules, m.group(2).strip(" -—:"))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ):
+                minfo.string_constants.add(node.value)
+        self.modules[minfo.module] = minfo
+        # module-level locks
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ):
+                callee = attr_chain(stmt.value.func) or ""
+                if callee.split(".")[-1] in ("Lock", "RLock"):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            minfo.module_locks.add(t.id)
+        self._index_scope(minfo, tree.body, cls=None, prefix="")
+
+    def _index_scope(self, minfo: ModuleInfo, body, cls: Optional[str],
+                     prefix: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                cinfo = ClassInfo(
+                    node.name, minfo.module,
+                    [attr_chain(b) or "" for b in node.bases],
+                )
+                self.classes.setdefault(node.name, []).append(cinfo)
+                self._index_scope(
+                    minfo, node.body, cls=node.name,
+                    prefix=f"{prefix}{node.name}.",
+                )
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                qual = f"{prefix}{node.name}"
+                finfo = FuncInfo(
+                    minfo.module, qual, node.name, cls, minfo.file,
+                    node.lineno,
+                )
+                self.funcs[finfo.key] = finfo
+                if cls is not None:
+                    for ci in self.classes.get(cls, []):
+                        if ci.module == minfo.module:
+                            ci.methods.add(node.name)
+                _FuncScanner(self, minfo, finfo).scan(node)
+                # nested defs: index with <locals> qualnames
+                self._index_nested(minfo, node, cls, qual)
+
+    def _index_nested(self, minfo: ModuleInfo, fnode, cls, parent_qual):
+        for node in ast.walk(fnode):
+            if node is fnode:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # only direct <locals> of parent_qual (one level is
+                # enough for the closure patterns in this repo)
+                qual = f"{parent_qual}.<locals>.{node.name}"
+                if (minfo.module, qual) in self.funcs:
+                    continue
+                finfo = FuncInfo(
+                    minfo.module, qual, node.name, cls, minfo.file,
+                    node.lineno,
+                )
+                self.funcs[finfo.key] = finfo
+                _FuncScanner(self, minfo, finfo).scan(node)
+
+    def _infer_attr_types(self) -> None:
+        """self.attr → class-name map from ctor assignments and
+        annotated parameters (``def __init__(self, shard: ParamShard)``
+        + ``self.shard = shard``)."""
+        for f in list(self.funcs.values()):
+            if f.cls is None:
+                continue
+            minfo = self.modules[f.module]
+            fnode = self._find_funcnode(minfo, f)
+            if fnode is None:
+                continue
+            ann: Dict[str, str] = {}
+            for a in list(fnode.args.args) + list(
+                fnode.args.kwonlyargs
+            ):
+                if a.annotation is not None:
+                    t = attr_chain(a.annotation)
+                    if t and t.split(".")[-1] in self.classes:
+                        ann[a.arg] = t.split(".")[-1]
+            for node in ast.walk(fnode):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    chain = attr_chain(t) if isinstance(
+                        t, ast.Attribute
+                    ) else None
+                    if not chain or not chain.startswith("self."):
+                        continue
+                    attr = chain[5:]
+                    if "." in attr:
+                        continue
+                    key = (f.module, f.cls, attr)
+                    if isinstance(node.value, ast.Call):
+                        callee = attr_chain(node.value.func) or ""
+                        name = callee.split(".")[-1]
+                        if name in self.classes:
+                            self.attr_types.setdefault(key, name)
+                    elif isinstance(node.value, ast.Name):
+                        if node.value.id in ann:
+                            self.attr_types.setdefault(
+                                key, ann[node.value.id]
+                            )
+
+    def _find_funcnode(self, minfo: ModuleInfo, f: FuncInfo):
+        for node in ast.walk(minfo.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.lineno == f.lineno and node.name == f.name:
+                    return node
+        return None
+
+    # -- resolution --------------------------------------------------------
+    def resolve_class(self, name: str) -> Optional[ClassInfo]:
+        cands = self.classes.get(name, [])
+        return cands[0] if len(cands) == 1 else (
+            cands[0] if cands else None
+        )
+
+    def resolve_method(self, module: str, clsname: Optional[str],
+                       meth: str, _seen=None) -> Optional[FuncInfo]:
+        if clsname is None:
+            return None
+        _seen = _seen or set()
+        if clsname in _seen:
+            return None
+        _seen.add(clsname)
+        for ci in self.classes.get(clsname, []):
+            f = self.funcs.get((ci.module, f"{clsname}.{meth}"))
+            if f is not None:
+                return f
+            for b in ci.bases:
+                base = b.split(".")[-1]
+                got = self.resolve_method(ci.module, base, meth, _seen)
+                if got is not None:
+                    return got
+        return None
+
+    def resolve_call(self, f: FuncInfo,
+                     c: CallSite) -> List[FuncInfo]:
+        if c.kind == "local":
+            nested = self.funcs.get(
+                (f.module, f"{f.qualname}.<locals>.{c.name}")
+            )
+            if nested is not None:
+                return [nested]
+            # sibling <locals> of the same parent function
+            if ".<locals>." in f.qualname:
+                parent = f.qualname.rsplit(".<locals>.", 1)[0]
+                sib = self.funcs.get(
+                    (f.module, f"{parent}.<locals>.{c.name}")
+                )
+                if sib is not None:
+                    return [sib]
+            mod_fn = self.funcs.get((f.module, c.name))
+            if mod_fn is not None:
+                return [mod_fn]
+            return []
+        if c.kind == "self":
+            got = self.resolve_method(f.module, f.cls, c.name)
+            return [got] if got is not None else []
+        if c.kind == "attr" and c.recv:
+            parts = c.recv.split(".")
+            if parts[0] == "self" and len(parts) == 2 and f.cls:
+                t = self.attr_types.get((f.module, f.cls, parts[1]))
+                if t is not None:
+                    got = self.resolve_method(f.module, t, c.name)
+                    return [got] if got is not None else []
+        return []
+
+    # -- thread-entry analysis --------------------------------------------
+    def class_descendants(self, base: str) -> Set[str]:
+        out: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, infos in self.classes.items():
+                if name in out or name == base:
+                    continue
+                for ci in infos:
+                    for b in ci.bases:
+                        if b.split(".")[-1] == base or (
+                            b.split(".")[-1] in out
+                        ):
+                            out.add(name)
+                            changed = True
+        out.add(base)
+        return out
+
+    def thread_entry_roots(self) -> Set[Tuple[str, str]]:
+        roots: Set[Tuple[str, str]] = set()
+        for f in self.funcs.values():
+            for kind, name, recv in f.thread_targets:
+                site = CallSite(kind, name, recv, (), f.lineno, None,
+                                (), 0)
+                for target in self.resolve_call(f, site):
+                    roots.add(target.key)
+        # LineServer handler overrides: each connection runs these on
+        # its own handler thread
+        for cls in self.class_descendants("LineServer"):
+            for meth in ("respond", "handle_connection"):
+                got = self.resolve_method("", cls, meth)
+                if got is not None:
+                    roots.add(got.key)
+        return roots
+
+    def reachable(self, roots: Set[Tuple[str, str]]
+                  ) -> Set[Tuple[str, str]]:
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            key = frontier.pop()
+            f = self.funcs.get(key)
+            if f is None:
+                continue
+            for c in f.calls:
+                for target in self.resolve_call(f, c):
+                    if target.key not in seen:
+                        seen.add(target.key)
+                        frontier.append(target.key)
+        return seen
+
+    # -- lock closure ------------------------------------------------------
+    def locks_closure(self, key: Tuple[str, str],
+                      _stack=None) -> Set[str]:
+        if key in self._locks_closure_memo:
+            return self._locks_closure_memo[key]
+        _stack = _stack or set()
+        if key in _stack:
+            return set()
+        _stack.add(key)
+        f = self.funcs.get(key)
+        out: Set[str] = set()
+        if f is not None:
+            for a in f.acquires:
+                out.add(a.lock)
+            for c in f.calls:
+                for target in self.resolve_call(f, c):
+                    out |= self.locks_closure(target.key, _stack)
+        _stack.discard(key)
+        self._locks_closure_memo[key] = out
+        return out
+
+    # -- allow lookup ------------------------------------------------------
+    def allow_for(self, module: str, rule: str,
+                  linenos: Sequence[Optional[int]]
+                  ) -> Optional[Tuple[str, bool]]:
+        """(justification, valid) when an allow-comment for ``rule``
+        covers any of the candidate lines; None when no allow at all."""
+        minfo = self.modules.get(module)
+        if minfo is None:
+            return None
+        for ln in linenos:
+            if ln is None:
+                continue
+            # an allow covers its own line and the line directly below
+            # it (the comment-above-the-def / comment-above-the-with
+            # placement long justifications need)
+            got = minfo.allows.get(ln) or minfo.allows.get(ln - 1)
+            if got is None:
+                continue
+            rules, just = got
+            if rule in rules or any(
+                r.lower() in ("all", "*") for r in rules
+            ):
+                return (just, bool(just))
+        return None

@@ -1,0 +1,196 @@
+"""fpsanalyze entry point — scan, run rules, diff against the
+baseline, exit nonzero on anything new.
+
+``run_analysis`` is the library surface the tier-1 test calls; ``main``
+wraps it for ``python -m tools.fpsanalyze`` and the ``fpsanalyze``
+console script.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .astindex import Index
+from .findings import Baseline, BaselineError, Finding
+from .rules_drift import (
+    DriftConfig,
+    default_drift_config,
+    run_metric_drift,
+    run_wire_verb_drift,
+)
+from .rules_locks import run_blocking_under_lock, run_lock_order
+from .rules_shared import run_unguarded_shared
+
+DEFAULT_SCAN = ("flink_parameter_server_tpu", "tools")
+ALL_RULES = ("L001", "B001", "S001", "D001", "D002")
+
+
+@dataclasses.dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    stale_baseline: List[str]
+    files_scanned: int
+
+    @property
+    def open_findings(self) -> List[Finding]:
+        return [f for f in self.findings if not f.baselined]
+
+    def as_dict(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "open": [f.as_dict() for f in self.open_findings],
+            "baselined": [
+                f.as_dict() for f in self.findings if f.baselined
+            ],
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def _collect_files(root: str,
+                   scan: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for top in scan:
+        base = os.path.join(root, top)
+        if os.path.isfile(base) and base.endswith(".py"):
+            out.append(os.path.relpath(base, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [
+                d for d in dirnames if d != "__pycache__"
+            ]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(
+                        os.path.relpath(
+                            os.path.join(dirpath, fn), root
+                        )
+                    )
+    return sorted(set(p.replace(os.sep, "/") for p in out))
+
+
+def run_analysis(
+    root: str,
+    *,
+    scan: Sequence[str] = DEFAULT_SCAN,
+    baseline_path: Optional[str] = "__default__",
+    drift: Optional[DriftConfig] = "__default__",  # type: ignore
+    rules: Sequence[str] = ALL_RULES,
+) -> AnalysisResult:
+    """Run the analyzer over ``root``.  ``baseline_path=None`` /
+    ``drift=None`` disable the baseline / the drift rules (fixture
+    runs); the ``"__default__"`` sentinels resolve to the committed
+    baseline and the repo surface map."""
+    root = os.path.abspath(root)
+    files = _collect_files(root, scan)
+    index = Index.build(root, files)
+    findings: List[Finding] = []
+    if "L001" in rules:
+        findings += run_lock_order(index)
+    if "B001" in rules:
+        findings += run_blocking_under_lock(index)
+    if "S001" in rules:
+        findings += run_unguarded_shared(index)
+    if drift == "__default__":
+        drift = default_drift_config(root)
+    if drift is not None:
+        if "D001" in rules:
+            findings += run_wire_verb_drift(index, root, drift)
+        if "D002" in rules:
+            findings += run_metric_drift(index, root, drift)
+    if baseline_path == "__default__":
+        baseline_path = os.path.join(
+            root, "tools", "fpsanalyze", "baseline.json"
+        )
+    baseline = Baseline.load(baseline_path)
+    stale = baseline.apply(findings)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return AnalysisResult(findings, stale, len(files))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fpsanalyze",
+        description=(
+            "project-native concurrency & drift analyzer "
+            "(docs/static_analysis.md)"
+        ),
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="repo root (default: nearest parent of this file "
+             "containing the package)",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report everything, accepted or not")
+    p.add_argument(
+        "--update-baseline", action="store_true",
+        help="merge open findings into baseline.json with EMPTY "
+             "justifications (the analyzer refuses the file until a "
+             "human fills them)",
+    )
+    p.add_argument(
+        "--rules", default=",".join(ALL_RULES),
+        help=f"comma-separated rule subset (default {','.join(ALL_RULES)})",
+    )
+    args = p.parse_args(argv)
+    root = args.root
+    if root is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        root = os.path.dirname(os.path.dirname(here))
+    if not os.path.isdir(
+        os.path.join(root, "flink_parameter_server_tpu")
+    ):
+        print(f"fpsanalyze: {root} does not look like the repo root",
+              file=sys.stderr)
+        return 2
+    rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+    try:
+        res = run_analysis(
+            root,
+            baseline_path=(
+                None if args.no_baseline else "__default__"
+            ),
+            rules=rules,
+        )
+    except BaselineError as e:
+        print(f"fpsanalyze: baseline error: {e}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        bl = Baseline.load(
+            os.path.join(root, "tools", "fpsanalyze", "baseline.json")
+        )
+        bl.path = os.path.join(
+            root, "tools", "fpsanalyze", "baseline.json"
+        )
+        bl.write_skeleton(res.findings)
+        print(
+            f"fpsanalyze: wrote {bl.path} — fill in the empty "
+            f"justifications (the analyzer refuses blank ones)"
+        )
+        return 0
+    if args.json:
+        print(json.dumps(res.as_dict(), indent=2))
+    else:
+        for f in res.open_findings:
+            print(str(f))
+        for key in res.stale_baseline:
+            print(f"stale baseline entry (fixed? delete it): {key}",
+                  file=sys.stderr)
+        n_base = sum(1 for f in res.findings if f.baselined)
+        print(
+            f"fpsanalyze: {res.files_scanned} files, "
+            f"{len(res.open_findings)} open finding(s), "
+            f"{n_base} baselined, {len(res.stale_baseline)} stale "
+            f"baseline entr(ies)"
+        )
+    return 1 if res.open_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Findings + baseline engine.
+
+A finding is ``(rule, file, line, message, key)``.  The ``key`` is the
+line-number-FREE identity — ``rule:file:symbol:detail`` — so a baseline
+entry survives unrelated edits to the file (a baseline keyed on line
+numbers would need re-blessing on every reflow, which is how baselines
+rot into rubber stamps).
+
+The committed baseline (``tools/fpsanalyze/baseline.json``) is the set
+of accepted findings; EVERY entry must carry a non-empty
+``justification`` — the analyzer refuses a silent baseline.  Unmatched
+entries are reported as stale (warning, not failure: a fixed finding
+should prompt deleting its entry, not break the build).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    file: str  # root-relative
+    line: int
+    message: str
+    key: str
+    baselined: bool = False
+    justification: Optional[str] = None  # from baseline or allow-comment
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        tag = " [baselined]" if self.baselined else ""
+        return f"{self.rule} {self.file}:{self.line}: {self.message}{tag}"
+
+
+def make_key(rule: str, file: str, symbol: str, detail: str = "") -> str:
+    parts = [rule, file, symbol]
+    if detail:
+        parts.append(detail)
+    return ":".join(parts)
+
+
+class BaselineError(ValueError):
+    """The baseline file itself is malformed (bad JSON, missing
+    justification) — a hard error, never a skipped check."""
+
+
+@dataclasses.dataclass
+class Baseline:
+    path: Optional[str]
+    entries: Dict[str, str]  # key -> justification
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if path is None or not os.path.exists(path):
+            return cls(path, {})
+        with open(path, encoding="utf-8") as f:
+            try:
+                doc = json.load(f)
+            except ValueError as e:
+                raise BaselineError(f"{path}: not valid JSON: {e}")
+        entries: Dict[str, str] = {}
+        for i, e in enumerate(doc.get("entries", [])):
+            key = e.get("key")
+            just = e.get("justification")
+            if not isinstance(key, str) or not key:
+                raise BaselineError(
+                    f"{path}: entry {i} has no 'key'"
+                )
+            if not isinstance(just, str) or not just.strip():
+                raise BaselineError(
+                    f"{path}: entry {key!r} has no justification — "
+                    f"every baselined finding must say WHY it is "
+                    f"accepted"
+                )
+            entries[key] = just.strip()
+        return cls(path, entries)
+
+    def apply(self, findings: List[Finding]) -> List[str]:
+        """Mark baselined findings in place; return the STALE entry
+        keys (baselined but no longer found)."""
+        seen = set()
+        for f in findings:
+            just = self.entries.get(f.key)
+            if just is not None:
+                f.baselined = True
+                f.justification = just
+                seen.add(f.key)
+        return sorted(set(self.entries) - seen)
+
+    def write_skeleton(self, findings: List[Finding]) -> None:
+        """--update-baseline: merge currently-open findings into the
+        file with empty justifications for a human to fill (the
+        analyzer will refuse the file until they do)."""
+        assert self.path is not None
+        merged = dict(self.entries)
+        for f in findings:
+            if not f.baselined:
+                merged.setdefault(f.key, "")
+        doc = {
+            "version": 1,
+            "entries": [
+                {"key": k, "justification": v}
+                for k, v in sorted(merged.items())
+            ],
+        }
+        with open(self.path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")

@@ -1,0 +1,449 @@
+"""Rules D001 (wire-verb drift) + D002 (metric-catalog drift).
+
+**D001** reconciles three views of each wire surface:
+
+  * *handled* — the verbs a server dispatches: string comparisons
+    against the ``cmd`` variable in the surface's dispatch function
+    (``ShardServer._execute``, ``ServingServer._admit`` — the repo's
+    one dispatch idiom);
+  * *emitted* — the verbs clients put on the wire: first tokens of
+    string constants passed to ``request``/``request_many``/
+    ``request_lines`` calls, of ``"verb "``-shaped leading constants
+    in frame-building expressions, and of f-string heads, in the
+    surface's emitter modules (``ClusterClient``, the migration data
+    plane, ``psctl``);
+  * *documented* — the verb lines of the fenced code block following
+    the surface's ``<!-- fpsanalyze: wire-verbs <surface> -->`` marker
+    in its doc page (a verb line starts at column 0; ``ok``/``err``
+    response lines and indented continuations are ignored).
+
+Checks: every emitted verb is handled (a phantom verb hangs or errors
+at runtime), every handled verb is documented, every documented verb
+is handled (docs describing dead verbs teach operators a protocol that
+does not exist).
+
+**D002** reconciles the metric plane: every literal instrument
+registration ``reg.counter("name", component="c")`` (gauge/histogram
+alike) must (1) use a component in ``tools/check_metric_lines.py``
+KNOWN_COMPONENTS — read from that module, the single source — and
+(2) appear somewhere in the docs set; every name in the docs'
+instrument-catalog tables (rows of tables whose header contains
+``instrument``) must correspond to a registration.  Components in
+KNOWN_COMPONENTS must be referenced somewhere in the scanned tree
+(a string literal suffices — some components are stamped dynamically).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .astindex import Index, attr_chain
+from .findings import Finding, make_key
+
+_VERB_RE = re.compile(r"^[a-z][a-z0-9_]{1,15}$")
+_METRIC_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REQUEST_FNS = frozenset({"request", "request_many", "request_lines"})
+
+
+@dataclasses.dataclass
+class WireSurface:
+    name: str
+    handler: Tuple[str, str]  # (root-relative file, dispatch func name)
+    emitters: Sequence[str]  # root-relative emitter files ([] = skip)
+    doc: Tuple[str, str]  # (root-relative doc file, marker tag)
+
+
+@dataclasses.dataclass
+class DriftConfig:
+    surfaces: Sequence[WireSurface]
+    metric_doc_files: Sequence[str]  # code -> docs: any mention counts
+    catalog_doc_files: Sequence[str]  # docs -> code: instrument tables
+    known_components: FrozenSet[str]
+    metric_scan_prefixes: Sequence[str]  # files to harvest registrations
+
+
+def default_drift_config(root: str) -> DriftConfig:
+    pkg = "flink_parameter_server_tpu"
+    docs = sorted(
+        os.path.join("docs", n)
+        for n in os.listdir(os.path.join(root, "docs"))
+        if n.endswith(".md")
+    ) if os.path.isdir(os.path.join(root, "docs")) else []
+    from tools.check_metric_lines import KNOWN_COMPONENTS
+
+    return DriftConfig(
+        surfaces=[
+            WireSurface(
+                "shard",
+                (f"{pkg}/cluster/shard.py", "_execute"),
+                [
+                    f"{pkg}/cluster/client.py",
+                    f"{pkg}/elastic/migration.py",
+                    f"{pkg}/elastic/controller.py",
+                    f"{pkg}/elastic/hedging.py",
+                    "tools/psctl.py",
+                ],
+                ("docs/cluster.md", "wire-verbs shard"),
+            ),
+            WireSurface(
+                "serving",
+                (f"{pkg}/serving/server.py", "_admit"),
+                [],  # ServingClient is in-process; TCP callers are
+                # examples/tests, not production emitters
+                ("docs/serving.md", "wire-verbs serving"),
+            ),
+        ],
+        metric_doc_files=docs,
+        catalog_doc_files=[
+            "docs/observability.md", "docs/cluster.md",
+            "docs/elastic.md",
+        ],
+        known_components=KNOWN_COMPONENTS,
+        metric_scan_prefixes=[pkg + "/"],
+    )
+
+
+# -- wire-verb extraction -----------------------------------------------------
+
+
+def _handled_verbs(index: Index, file: str,
+                   func_name: str) -> Tuple[Set[str], Optional[str]]:
+    """Verbs compared against the ``cmd`` variable in the dispatch
+    function; also returns the module name for error anchoring."""
+    minfo = next(
+        (m for m in index.modules.values() if m.file == file), None
+    )
+    if minfo is None:
+        return set(), None
+    verbs: Set[str] = set()
+    for node in ast.walk(minfo.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        if node.name != func_name:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Compare):
+                continue
+            if not (isinstance(sub.left, ast.Name)
+                    and sub.left.id == "cmd"):
+                continue
+            for comparator in sub.comparators:
+                if isinstance(comparator, ast.Constant) and isinstance(
+                    comparator.value, str
+                ):
+                    if _VERB_RE.match(comparator.value):
+                        verbs.add(comparator.value)
+                elif isinstance(comparator, ast.Tuple):
+                    for elt in comparator.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ) and _VERB_RE.match(elt.value):
+                            verbs.add(elt.value)
+    return verbs, minfo.module
+
+
+def _first_token(s: str) -> Optional[str]:
+    tok = s.split(None, 1)[0] if s.strip() else None
+    if tok and _VERB_RE.match(tok) and tok not in ("ok", "err"):
+        return tok
+    return None
+
+
+def _emitted_verbs(index: Index,
+                   files: Sequence[str]) -> Dict[str, Tuple[str, int]]:
+    """verb -> representative (file, line) across the emitter set."""
+    out: Dict[str, Tuple[str, int]] = {}
+
+    def note(verb: Optional[str], file: str, line: int) -> None:
+        if verb is not None:
+            out.setdefault(verb, (file, line))
+
+    for minfo in index.modules.values():
+        if minfo.file not in files:
+            continue
+        for node in ast.walk(minfo.tree):
+            if isinstance(node, ast.Call):
+                fname = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else node.func.id
+                    if isinstance(node.func, ast.Name) else ""
+                )
+                if fname not in _REQUEST_FNS:
+                    continue
+                for arg in node.args:
+                    elts = (
+                        arg.elts
+                        if isinstance(arg, (ast.List, ast.Tuple))
+                        else [arg]
+                    )
+                    for elt in elts:
+                        if isinstance(elt, ast.Constant) and \
+                                isinstance(elt.value, str):
+                            note(_first_token(elt.value),
+                                 minfo.file, elt.lineno)
+                        elif isinstance(elt, ast.JoinedStr) and \
+                                elt.values and isinstance(
+                                    elt.values[0], ast.Constant):
+                            note(_first_token(
+                                str(elt.values[0].value)
+                            ), minfo.file, elt.lineno)
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Add
+            ):
+                # "pull " + ..., the frame-building idiom: the leading
+                # constant of a + chain whose text is exactly "verb "
+                left = node.left
+                while isinstance(left, ast.BinOp):
+                    left = left.left
+                if isinstance(left, ast.Constant) and isinstance(
+                    left.value, str
+                ):
+                    v = left.value
+                    if v.endswith(" ") and _VERB_RE.match(v[:-1]):
+                        note(v[:-1], minfo.file, left.lineno)
+    return out
+
+
+def _documented_verbs(root: str, doc_file: str,
+                      marker: str) -> Optional[Set[str]]:
+    """Verb lines of the fenced block after the surface marker; None
+    when the marker (or the file) is missing."""
+    path = os.path.join(root, doc_file)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    tag = f"<!-- fpsanalyze: wire-verbs {marker.split()[-1]} -->"
+    try:
+        start = next(
+            i for i, ln in enumerate(lines)
+            if tag in ln or f"fpsanalyze: {marker}" in ln
+        )
+    except StopIteration:
+        return None
+    verbs: Set[str] = set()
+    in_block = False
+    for ln in lines[start:]:
+        if ln.strip().startswith("```"):
+            if in_block:
+                return verbs
+            in_block = True
+            continue
+        if not in_block:
+            continue
+        if not ln or ln[0].isspace():
+            continue  # response lines / continuations are indented
+        tok = _first_token(ln)
+        if tok is not None:
+            verbs.add(tok)
+    return verbs if in_block else None
+
+
+def run_wire_verb_drift(index: Index, root: str,
+                        config: DriftConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    for surf in config.surfaces:
+        handler_file, handler_fn = surf.handler
+        handled, _mod = _handled_verbs(index, handler_file, handler_fn)
+        if not handled:
+            findings.append(Finding(
+                "D001", handler_file, 1,
+                f"could not extract any handled verbs from "
+                f"{handler_fn}() — the dispatch idiom changed; update "
+                f"tools/fpsanalyze/rules_drift.py",
+                make_key("D001", handler_file,
+                         f"{surf.name}:no-handler-verbs"),
+            ))
+            continue
+        emitted = _emitted_verbs(index, surf.emitters)
+        documented = _documented_verbs(root, *surf.doc)
+        for verb, (file, line) in sorted(emitted.items()):
+            if verb not in handled:
+                findings.append(Finding(
+                    "D001", file, line,
+                    f"client emits verb {verb!r} but "
+                    f"{handler_file}:{handler_fn}() has no handler — "
+                    f"phantom verb",
+                    make_key("D001", file,
+                             f"{surf.name}:phantom:{verb}"),
+                ))
+        if documented is None:
+            findings.append(Finding(
+                "D001", surf.doc[0], 1,
+                f"no '<!-- fpsanalyze: wire-verbs {surf.name} -->' "
+                f"marked block in {surf.doc[0]} — the {surf.name} "
+                f"verb set is undocumented",
+                make_key("D001", surf.doc[0],
+                         f"{surf.name}:no-doc-block"),
+            ))
+            continue
+        for verb in sorted(handled - documented):
+            findings.append(Finding(
+                "D001", surf.doc[0], 1,
+                f"server verb {verb!r} ({handler_file}) is missing "
+                f"from the {surf.doc[0]} wire-protocol block",
+                make_key("D001", surf.doc[0],
+                         f"{surf.name}:undocumented:{verb}"),
+            ))
+        for verb in sorted(documented - handled):
+            findings.append(Finding(
+                "D001", surf.doc[0], 1,
+                f"{surf.doc[0]} documents verb {verb!r} but "
+                f"{handler_file}:{handler_fn}() does not handle it — "
+                f"dead doc",
+                make_key("D001", surf.doc[0],
+                         f"{surf.name}:dead-doc:{verb}"),
+            ))
+    return findings
+
+
+# -- metric-catalog extraction ------------------------------------------------
+
+_INSTRUMENT_FNS = frozenset({"counter", "gauge", "histogram"})
+
+
+def registered_metrics(index: Index, prefixes: Sequence[str]
+                       ) -> List[Tuple[str, Optional[str], str, int]]:
+    """(name, component-literal-or-None, file, line) per literal
+    instrument registration in the scanned prefixes."""
+    out = []
+    for minfo in index.modules.values():
+        if not any(minfo.file.startswith(p) for p in prefixes):
+            continue
+        for node in ast.walk(minfo.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _INSTRUMENT_FNS):
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and _METRIC_RE.match(first.value)):
+                continue
+            component: Optional[str] = None
+            for kw in node.keywords:
+                if kw.arg == "component":
+                    if isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        component = kw.value.value
+                    else:
+                        component = None  # dynamic — not checkable
+            out.append(
+                (first.value, component, minfo.file, node.lineno)
+            )
+    return out
+
+
+def _doc_texts(root: str, files: Sequence[str]) -> str:
+    chunks = []
+    for rel in files:
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def _catalog_names(root: str,
+                   files: Sequence[str]) -> Dict[str, Tuple[str, int]]:
+    """Backticked metric names from instrument-catalog tables (tables
+    whose header row contains 'instrument')."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel in files:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        in_table = False
+        for i, ln in enumerate(lines, 1):
+            stripped = ln.strip()
+            if not stripped.startswith("|"):
+                in_table = False
+                continue
+            if "instrument" in stripped.lower() and not in_table:
+                in_table = True
+                continue
+            if not in_table or set(stripped) <= {"|", "-", " ", ":"}:
+                continue
+            first_cell = stripped.strip("|").split("|")[0]
+            for name in re.findall(r"`([a-z][a-z0-9_]*)`", first_cell):
+                out.setdefault(name, (rel, i))
+    return out
+
+
+def run_metric_drift(index: Index, root: str,
+                     config: DriftConfig) -> List[Finding]:
+    findings: List[Finding] = []
+    regs = registered_metrics(index, config.metric_scan_prefixes)
+    doc_text = _doc_texts(root, config.metric_doc_files)
+    catalog = _catalog_names(root, config.catalog_doc_files)
+    known = config.known_components
+    seen_names: Set[str] = set()
+    seen_components: Set[str] = set()
+    for name, component, file, line in regs:
+        seen_names.add(name)
+        if component is not None:
+            seen_components.add(component)
+            if component not in known:
+                findings.append(Finding(
+                    "D002", file, line,
+                    f"metric {name!r} registers component "
+                    f"{component!r} which is not in "
+                    f"tools/check_metric_lines.py KNOWN_COMPONENTS — "
+                    f"its registry lines would fail the JSON-lines "
+                    f"lint",
+                    make_key("D002", file,
+                             f"unknown-component:{component}:{name}"),
+                ))
+        pat = re.compile(
+            rf"(?<![a-z0-9_])(?:fps_)?{re.escape(name)}"
+            rf"(?![a-z0-9_])"
+        )
+        if not pat.search(doc_text):
+            findings.append(Finding(
+                "D002", file, line,
+                f"metric {name!r} is registered here but appears "
+                f"nowhere in the docs — uncatalogued instrument "
+                f"(docs/observability.md is the catalog)",
+                make_key("D002", file, f"uncatalogued:{name}"),
+            ))
+    for name, (rel, line) in sorted(catalog.items()):
+        if name not in seen_names:
+            findings.append(Finding(
+                "D002", rel, line,
+                f"docs catalog lists instrument {name!r} but no code "
+                f"registers it — dead catalog entry",
+                make_key("D002", rel, f"dead-catalog:{name}"),
+            ))
+    # every KNOWN component must be referenced in the tree (literal
+    # component= or any string constant — some are stamped dynamically)
+    all_strings: Set[str] = set()
+    for minfo in index.modules.values():
+        all_strings |= minfo.string_constants
+    for comp in sorted(known):
+        if comp not in seen_components and comp not in all_strings:
+            findings.append(Finding(
+                "D002", "tools/check_metric_lines.py", 1,
+                f"KNOWN_COMPONENTS contains {comp!r} but nothing in "
+                f"the tree references it — stale component",
+                make_key("D002", "tools/check_metric_lines.py",
+                         f"stale-component:{comp}"),
+            ))
+    # de-dup (the uncatalogued check can fire once per duplicate
+    # registration of the same name)
+    seen_keys: Set[str] = set()
+    out: List[Finding] = []
+    for fi in findings:
+        if fi.key in seen_keys:
+            continue
+        seen_keys.add(fi.key)
+        out.append(fi)
+    return out

@@ -1,0 +1,246 @@
+"""Rule L001 (lock-order-cycle) + rule B001 (blocking-under-lock).
+
+**L001** builds the lock-acquisition graph: an edge ``A → B`` means
+some code path acquires ``B`` while holding ``A`` — either directly
+(nested ``with`` in one function) or through the intra-package call
+graph (a locked region calls a function whose transitive closure
+acquires ``B``).  A cycle in that graph is a potential deadlock: two
+threads entering the cycle from different edges can each hold the lock
+the other wants.  One finding per strongly-connected component.
+
+**B001** flags blocking operations reached inside a held-lock region,
+directly or via ONE resolved call hop (deeper chains are out of scope
+by design — the one-hop bound keeps every finding human-auditable):
+
+  * socket ``send/sendall/sendto/recv/recv_into/recvfrom/accept/
+    connect`` — a peer that stops draining turns the lock into a
+    cluster-wide stall (the straggler-amplification shape of
+    arXiv:2308.15482);
+  * ``os.fsync`` / file ``flush`` / WAL ``sync`` — disk latency under
+    a lock serializes every other thread behind the platter;
+  * ``subprocess`` spawns, ``sleep``;
+  * ``Queue.get/put`` with no ``timeout=`` — unbounded waits.
+
+Receiver-name heuristics keep the noise down: ``.flush()`` only fires
+on file-like receiver names, ``.get/.put`` only on queue-like ones,
+``.sync()`` only on WAL-like ones.  The escape hatch
+``# fpsanalyze: allow[B001] <why>`` on the call line, its ``with``
+line, or the ``def`` line accepts a finding in place (justification
+required).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astindex import CallSite, FuncInfo, Index
+from .findings import Finding, make_key
+
+SOCKET_BLOCKING = frozenset({
+    "send", "sendall", "sendto", "recv", "recv_into", "recvfrom",
+    "accept", "connect",
+})
+FILEISH = frozenset({
+    "fh", "_fh", "f", "fp", "file", "_file", "stdout", "stderr",
+    "buffer", "_rfile", "_wfile",
+})
+QUEUEISH_SUFFIXES = ("queue", "_q", "inq", "outq")
+SUBPROCESS_FNS = frozenset({
+    "run", "popen", "check_call", "check_output", "call",
+})
+
+
+def blocking_kind(c: CallSite) -> Optional[str]:
+    """Human-readable blocking classification for a call site, or None."""
+    recv = c.recv or ""
+    terminal = recv.split(".")[-1].lower() if recv else ""
+    name = c.name
+    if c.kind == "attr":
+        if name in SOCKET_BLOCKING and terminal not in ("pool",):
+            return f"socket .{name}()"
+        if name == "fsync":
+            return "fsync"
+        if name == "flush" and terminal in FILEISH:
+            return "file flush"
+        if name == "sync" and "wal" in recv.lower():
+            return "WAL fsync (.sync())"
+        if name == "sleep":
+            return "sleep"
+        if name in ("get", "put"):
+            queueish = terminal.endswith(QUEUEISH_SUFFIXES) or (
+                "queue" in terminal
+            )
+            if queueish and "timeout" not in c.keywords:
+                return f"Queue.{name}() without timeout"
+        if recv.split(".")[0] == "subprocess" and (
+            name.lower() in SUBPROCESS_FNS or name == "Popen"
+        ):
+            return f"subprocess.{name}"
+    elif c.kind == "local":
+        if name == "sleep":
+            return "sleep"
+        if name == "fsync":
+            return "fsync"
+    return None
+
+
+def _fmt_lock(lock: str) -> str:
+    """Compact lock id for messages (strip the package prefix)."""
+    return lock.replace("flink_parameter_server_tpu.", "")
+
+
+def run_lock_order(index: Index) -> List[Finding]:
+    # edges: (A, B) -> representative (file, line, via)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for f in index.funcs.values():
+        for a in f.acquires:
+            for h in a.held:
+                if h != a.lock:
+                    edges.setdefault(
+                        (h, a.lock),
+                        (f.file, a.lineno, f.qualname),
+                    )
+        for c in f.calls:
+            if not c.held:
+                continue
+            for target in index.resolve_call(f, c):
+                for lock in index.locks_closure(target.key):
+                    for h in c.held:
+                        if h != lock:
+                            edges.setdefault(
+                                (h, lock),
+                                (f.file, c.lineno,
+                                 f"{f.qualname} -> "
+                                 f"{target.qualname}"),
+                            )
+    # strongly-connected components (iterative Tarjan-lite via
+    # Kosaraju: small graphs, clarity over speed)
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    order: List[str] = []
+    seen: Set[str] = set()
+    for start in graph:
+        if start in seen:
+            continue
+        stack = [(start, iter(graph[start]))]
+        seen.add(start)
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, iter(graph[nxt])))
+                    advanced = True
+                    break
+            if not advanced:
+                order.append(node)
+                stack.pop()
+    rev: Dict[str, Set[str]] = {n: set() for n in graph}
+    for (a, b) in edges:
+        rev[b].add(a)
+    comp: Dict[str, int] = {}
+    comps: List[List[str]] = []
+    for start in reversed(order):
+        if start in comp:
+            continue
+        cid = len(comps)
+        members = [start]
+        comp[start] = cid
+        frontier = [start]
+        while frontier:
+            n = frontier.pop()
+            for p in rev[n]:
+                if p not in comp:
+                    comp[p] = cid
+                    members.append(p)
+                    frontier.append(p)
+        comps.append(members)
+    findings: List[Finding] = []
+    for members in comps:
+        if len(members) < 2:
+            continue
+        cyc = sorted(members)
+        sites = []
+        for (a, b), (file, line, via) in sorted(edges.items()):
+            if a in members and b in members:
+                sites.append((file, line, a, b, via))
+        file, line = (sites[0][0], sites[0][1]) if sites else ("?", 0)
+        detail = "; ".join(
+            f"{_fmt_lock(a)}->{_fmt_lock(b)} at {fl}:{ln} ({via})"
+            for fl, ln, a, b, via in sites[:4]
+        )
+        findings.append(Finding(
+            "L001", file, line,
+            f"lock-order cycle between "
+            f"{', '.join(_fmt_lock(m) for m in cyc)} — potential "
+            f"deadlock ({detail})",
+            make_key("L001", file, "+".join(_fmt_lock(m) for m in cyc)),
+        ))
+    return findings
+
+
+def _blocking_findings_for_region(
+    index: Index, f: FuncInfo, c: CallSite, kind: str,
+    via: Optional[FuncInfo], out: List[Finding],
+) -> None:
+    lock = _fmt_lock(c.held[-1]) if c.held else "?"
+    hop = f" (reached via {via.qualname}())" if via is not None else ""
+    # the finding anchors at the CALLING function's site (where the
+    # lock is held); the key names both ends so it is stable
+    symbol = f.qualname
+    detail = kind.replace(" ", "_")
+    if via is not None:
+        detail = f"{via.qualname}:{detail}"
+    allow_lines = [c.lineno, c.region_lineno, f.lineno]
+    allow = index.allow_for(f.module, "B001", allow_lines)
+    if allow is not None:
+        just, valid = allow
+        if valid:
+            return  # accepted in place
+        out.append(Finding(
+            "B001", f.file, c.lineno,
+            f"allow[B001] here carries no justification — the escape "
+            f"hatch requires one",
+            make_key("B001", f.file, symbol, "allow-missing-"
+                     f"justification:{detail}"),
+        ))
+        return
+    out.append(Finding(
+        "B001", f.file, c.lineno,
+        f"blocking {kind} under {lock}{hop} — every thread "
+        f"contending for the lock stalls behind this I/O",
+        make_key("B001", f.file, symbol, detail),
+    ))
+
+
+def run_blocking_under_lock(index: Index) -> List[Finding]:
+    findings: List[Finding] = []
+    seen_keys: Set[str] = set()
+    for f in index.funcs.values():
+        for c in f.calls:
+            if not c.held:
+                continue
+            kind = blocking_kind(c)
+            if kind is not None:
+                _blocking_findings_for_region(
+                    index, f, c, kind, None, findings
+                )
+                continue
+            # one call hop: direct blocking calls in the resolved callee
+            for target in index.resolve_call(f, c):
+                for tc in target.calls:
+                    tkind = blocking_kind(tc)
+                    if tkind is not None:
+                        _blocking_findings_for_region(
+                            index, f, c, tkind, target, findings
+                        )
+                        break  # one finding per (caller site, callee)
+    out = []
+    for fi in findings:
+        if fi.key in seen_keys:
+            continue
+        seen_keys.add(fi.key)
+        out.append(fi)
+    return out

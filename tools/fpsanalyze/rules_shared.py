@@ -1,0 +1,131 @@
+"""Rule S001 — unguarded shared-state mutation.
+
+Two fire conditions, both anchored on the repo's thread model (worker
+threads, per-connection handler threads, poll loops):
+
+  * **(a) read-modify-write on a thread entry path**: an augmented
+    assignment (``self.x += 1``) with no lock held, inside a function
+    that IS a thread entry point — a ``threading.Thread(target=…)``
+    target or a ``LineServer`` handler override.  Handler threads run
+    concurrently per connection, and ``+=`` on an attribute is never
+    atomic (BINARY_OP + STORE_ATTR interleave under the GIL), so two
+    handlers can lose increments forever.  Scoped to DIRECT entry
+    functions: transitively-reached methods are covered by (b), which
+    requires a second writer — otherwise every instance-local counter
+    in a worker-owned object would fire.
+
+  * **(b) cross-context plain assignment**: an attribute assigned in a
+    thread-REACHABLE function (transitive closure from the entry
+    points) AND in a different non-``__init__`` method outside the
+    thread closure, where the two sides share no common lock.  That is
+    the classic torn-publish shape: a control-plane method swaps state
+    a worker thread reads/writes mid-flight.
+
+``# fpsanalyze: allow[S001] <why>`` on the write line, the enclosing
+``with`` line, or the ``def`` line accepts a finding in place.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .astindex import AttrWrite, FuncInfo, Index
+from .findings import Finding, make_key
+
+
+def _emit(index: Index, f: FuncInfo, w: AttrWrite, message: str,
+          detail: str, out: List[Finding]) -> None:
+    allow = index.allow_for(
+        f.module, "S001", [w.lineno, w.region_lineno, f.lineno]
+    )
+    if allow is not None:
+        just, valid = allow
+        if valid:
+            return
+        out.append(Finding(
+            "S001", f.file, w.lineno,
+            "allow[S001] here carries no justification — the escape "
+            "hatch requires one",
+            make_key("S001", f.file, f.qualname,
+                     f"allow-missing-justification:{detail}"),
+        ))
+        return
+    out.append(Finding(
+        "S001", f.file, w.lineno, message,
+        make_key("S001", f.file, f.qualname, detail),
+    ))
+
+
+def run_unguarded_shared(index: Index) -> List[Finding]:
+    roots = index.thread_entry_roots()
+    reachable = index.reachable(roots)
+    findings: List[Finding] = []
+
+    # (a) unlocked read-modify-write in a DIRECT thread-entry function
+    for key in sorted(roots):
+        f = index.funcs.get(key)
+        if f is None:
+            continue
+        for w in f.writes:
+            if w.aug and not w.held:
+                _emit(
+                    index, f, w,
+                    f"unguarded read-modify-write of {w.chain} in "
+                    f"thread-entry {f.qualname}() — concurrent "
+                    f"threads lose updates (+= is not atomic)",
+                    f"aug:{w.chain}",
+                    findings,
+                )
+
+    # (b) same attribute plain-assigned from thread context AND from a
+    # non-thread method, with no common lock.  Attribute identity is
+    # (class, terminal attr) for self.<attr> writes — chains through
+    # other objects (self.shard.x) are left to (a).
+    by_attr: Dict[Tuple[str, str, str],
+                  List[Tuple[FuncInfo, AttrWrite]]] = {}
+    for f in index.funcs.values():
+        if f.cls is None or f.name == "__init__":
+            continue
+        for w in f.writes:
+            if w.aug:
+                continue
+            parts = w.chain.split(".")
+            if len(parts) != 2 or parts[0] != "self":
+                continue
+            by_attr.setdefault(
+                (f.module, f.cls, w.attr), []
+            ).append((f, w))
+    for (module, cls, attr), writes in sorted(by_attr.items()):
+        thread_side = [
+            (f, w) for f, w in writes if f.key in reachable
+        ]
+        other_side = [
+            (f, w) for f, w in writes if f.key not in reachable
+        ]
+        if not thread_side or not other_side:
+            continue
+        # a common lock across EVERY write site is the guarded case
+        lock_sets = [set(w.held) for _, w in writes]
+        common = set.intersection(*lock_sets) if lock_sets else set()
+        if common:
+            continue
+        f, w = thread_side[0]
+        others = ", ".join(
+            f"{of.qualname}():{ow.lineno}" for of, ow in other_side[:3]
+        )
+        _emit(
+            index, f, w,
+            f"{cls}.{attr} is assigned on a thread path "
+            f"({f.qualname}():{w.lineno}) and from {others} with no "
+            f"common lock — torn publish across threads",
+            f"xthread:{cls}.{attr}",
+            findings,
+        )
+    # de-dup by key (several sites can collapse to one identity)
+    seen: Set[str] = set()
+    out: List[Finding] = []
+    for fi in findings:
+        if fi.key in seen:
+            continue
+        seen.add(fi.key)
+        out.append(fi)
+    return out
