@@ -905,6 +905,58 @@ def _emit_elastic_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_failover_metric(platform: str, fallback: bool) -> None:
+    """Seventh (opt-in) metric line: replica-chain failover.
+
+    FPS_BENCH_FAILOVER=1 runs the kill-primary-mid-train-while-serve
+    experiment (benchmarks/failover_time.py: promote the follower,
+    measure kill→publish against a full WAL-rebuild replace_shard on
+    the same log length, count serving reads through the window) and
+    writes ``results/<platform>/failover_time.{md,json}`` — the
+    artifact any failover claim must cite (docs/perf_status.md).
+    Default 0 (the run costs tens of seconds); failure degrades to a
+    value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_FAILOVER", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_FAILOVER={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "replica-chain failover (kill primary mid-train-while-serve)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.failover_time import run_failover_bench
+
+        r = run_failover_bench()
+        print(json.dumps({
+            "metric": metric,
+            "value": r["failover_seconds"],
+            "unit": "seconds",
+            "extra": {
+                "failover_seconds": r["failover_seconds"],
+                "replace_seconds": r["replace_seconds"],
+                "speedup_vs_replace": r["speedup_vs_replace"],
+                "reads_served_during_failover":
+                    r["reads_served_during_failover"],
+                "read_errors": r["read_errors"],
+                "lag_records_at_promote": r["lag_records_at_promote"],
+                "records_salvaged": r["records_salvaged"],
+                "promoted_bitwise_equal": r["promoted_bitwise_equal"],
+                "replication_factor": r["replication_factor"],
+                "rounds": r["rounds"],
+                "batch": r["batch"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "seconds",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -932,6 +984,7 @@ def main():
             _emit_telemetry_summary(platform, fallback)
             _emit_cluster_metric(platform, fallback)
             _emit_elastic_metric(platform, fallback)
+            _emit_failover_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -986,6 +1039,7 @@ def main():
     _emit_telemetry_summary(platform, fallback)
     _emit_cluster_metric(platform, fallback)
     _emit_elastic_metric(platform, fallback)
+    _emit_failover_metric(platform, fallback)
 
 
 if __name__ == "__main__":

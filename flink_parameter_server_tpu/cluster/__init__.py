@@ -19,7 +19,9 @@ from .partition import (
     RangePartitioner,
 )
 from .shard import (
+    FollowerLagging,
     FrozenKeys,
+    NotPrimary,
     ParamShard,
     ShardCrashed,
     ShardServer,
@@ -32,7 +34,9 @@ __all__ = [
     "ClusterDriver",
     "ClusterResult",
     "ConsistentHashPartitioner",
+    "FollowerLagging",
     "FrozenKeys",
+    "NotPrimary",
     "ParamShard",
     "Partitioner",
     "RangePartitioner",

@@ -52,6 +52,18 @@ addresses, no epoch tags, rejections raise.
 ``hedge=`` accepts a :class:`~..elastic.hedging.Hedger`: pull frames
 race a budgeted backup connection against a slow shard — first answer
 wins (pulls are idempotent; pushes are never hedged).
+
+Replica-chain read routing (replication/, docs/elastic.md): when the
+membership view carries ``replicas`` (or a static ``replicas=`` is
+passed), pulls round-robin across ``[primary] + followers`` per shard.
+A follower that declines (``err lagging`` past its staleness bound,
+``err not-primary`` after a promotion) or cannot be reached FALLS BACK
+to the primary — counted in ``replication_follower_fallbacks_total``,
+never an error and never a membership refresh.  With a hedger
+attached, a replica read that stalls races its budgeted backup against
+the PRIMARY.  Writes always route to the primary.  ``connect_timeout``
+bounds the dial separately from the read deadline — failure detection
+for failover must not sit behind a 30 s read.
 """
 from __future__ import annotations
 
@@ -93,8 +105,14 @@ class ShardConnection:
         *,
         window: int = 8,
         timeout: float = 30.0,
-        connect_timeout: float = 10.0,
+        connect_timeout: Optional[float] = None,
     ):
+        # dial and read deadlines are separate levers (failover-grade
+        # failure detection needs a tight dial even when reads may
+        # legitimately wait); None inherits the read timeout, capped
+        # at the old 10 s dial default
+        if connect_timeout is None:
+            connect_timeout = min(float(timeout), 10.0)
         if window < 1:
             raise ValueError(f"window={window}: must be >= 1")
         self.host, self.port = host, port
@@ -187,6 +205,16 @@ def _is_reject(resp: str) -> bool:
     )
 
 
+def _is_follower_reject(resp: str) -> bool:
+    """A replica-chain follower declining a read: lagging past the
+    staleness bound, or no longer a follower at all.  The client falls
+    back to the primary — NOT a membership refresh (the map is fine;
+    this one replica is stale)."""
+    return resp.startswith("err lagging") or resp.startswith(
+        "err not-primary"
+    )
+
+
 class _Rejected(Exception):
     """Internal: carries the ids a shard rejected (stale-epoch/frozen)
     or could not be reached for, so the batch loop replays exactly
@@ -217,10 +245,13 @@ class ClusterClient(ParameterServerClient):
         window: int = 8,
         chunk: int = 512,
         timeout: float = 30.0,
+        connect_timeout: float = 5.0,
         wire_format: str = "b64",
         registry=None,
         worker: Optional[str] = None,
         membership=None,
+        replicas=None,
+        read_replicas: bool = True,
         hedge=None,
         retry_timeout: float = 30.0,
         retry_sleep_s: float = 0.002,
@@ -244,11 +275,16 @@ class ClusterClient(ParameterServerClient):
             self._epoch: Optional[int] = None
             self.partitioner = partitioner
             self._addresses = [tuple(a) for a in addresses]
+            self._replicas = (
+                [tuple(tuple(a) for a in r) for r in replicas]
+                if replicas else []
+            )
         else:
             view = membership.current()
             self._epoch = view.epoch
             self.partitioner = view.partitioner
             self._addresses = [tuple(a) for a in view.addresses]
+            self._replicas = [tuple(r) for r in view.replicas]
         if chunk < 1:
             raise ValueError(f"chunk={chunk}: must be >= 1")
         if wire_format not in ("text", "b64"):
@@ -262,6 +298,13 @@ class ClusterClient(ParameterServerClient):
         self.wire_format = wire_format
         self._window = int(window)
         self._timeout = float(timeout)
+        self._connect_timeout = float(connect_timeout)
+        # replica-chain read routing (replication/, docs/elastic.md):
+        # pulls rotate across [primary] + followers; follower rejects
+        # and connection errors fall back to the primary.  Writes
+        # always go to the primary.
+        self._read_replicas = bool(read_replicas)
+        self._rr: Dict[int, int] = {}
         self.retry_timeout = float(retry_timeout)
         self.retry_sleep_s = float(retry_sleep_s)
         self._conns: Dict[Tuple[str, int], ShardConnection] = {}
@@ -318,10 +361,22 @@ class ClusterClient(ParameterServerClient):
                 if membership is not None
                 else None
             )
+            if membership is not None or replicas:
+                self._c_replica_reads = reg.counter(
+                    "replication_replica_reads_total",
+                    component="replication", **labels,
+                )
+                self._c_fallbacks = reg.counter(
+                    "replication_follower_fallbacks_total",
+                    component="replication", **labels,
+                )
+            else:
+                self._c_replica_reads = self._c_fallbacks = None
         else:
             self._h_rtt = None
             self._c_refresh = None
             self._c_storms = None
+            self._c_replica_reads = self._c_fallbacks = None
         # latency-budget phases (telemetry/profiler.py): per-frame
         # client serialize / round trip / parse — the client side of
         # the budget.  registry=False implies profiling off too.
@@ -337,26 +392,32 @@ class ClusterClient(ParameterServerClient):
         return sum(c.inflight for c in list(self._conns.values()))
 
     # -- connections / membership -------------------------------------------
-    def _conn_for(self, shard: int) -> ShardConnection:
-        addr = self._addresses[shard]
+    def _conn_for_addr(self, addr: Tuple[str, int]) -> ShardConnection:
         conn = self._conns.get(addr)
         if conn is None:
             conn = ShardConnection(
                 addr[0], addr[1], window=self._window,
                 timeout=self._timeout,
+                connect_timeout=self._connect_timeout,
             )
             self._conns[addr] = conn
         return conn
 
-    def _drop_conn(self, shard: int) -> None:
-        conn = self._conns.pop(self._addresses[shard], None)
+    def _conn_for(self, shard: int) -> ShardConnection:
+        return self._conn_for_addr(self._addresses[shard])
+
+    def _drop_addr(self, addr: Tuple[str, int]) -> None:
+        conn = self._conns.pop(addr, None)
         if conn is not None:
             conn.close()
 
+    def _drop_conn(self, shard: int) -> None:
+        self._drop_addr(self._addresses[shard])
+
     def _refresh_membership(self) -> bool:
         """Re-read the membership view; adopt a newer epoch's map +
-        addresses (closing connections to addresses that left).
-        Returns True when a new epoch was adopted."""
+        addresses + replica sets (closing connections to addresses
+        that left).  Returns True when a new epoch was adopted."""
         if self.membership is None:
             return False
         view = self.membership.current()
@@ -365,13 +426,36 @@ class ClusterClient(ParameterServerClient):
         self._epoch = view.epoch
         self.partitioner = view.partitioner
         new_addrs = [tuple(a) for a in view.addresses]
+        new_replicas = [tuple(r) for r in view.replicas]
+        keep = set(new_addrs)
+        for reps in new_replicas:
+            keep.update(reps)
         for addr in list(self._conns):
-            if addr not in new_addrs:
+            if addr not in keep:
                 self._conns.pop(addr).close()
         self._addresses = new_addrs
+        self._replicas = new_replicas
         if self._c_refresh is not None:
             self._c_refresh.inc()
         return True
+
+    # -- replica-chain read routing ------------------------------------------
+    def _read_target(self, shard: int) -> Tuple[Tuple[str, int], bool]:
+        """Where the next read for ``shard`` goes: round-robin across
+        the primary + its followers (``(addr, is_replica)``)."""
+        primary = self._addresses[shard]
+        reps = (
+            self._replicas[shard]
+            if self._read_replicas and shard < len(self._replicas)
+            else ()
+        )
+        if not reps:
+            return primary, False
+        targets = [primary] + list(reps)
+        i = self._rr.get(shard, 0)
+        self._rr[shard] = i + 1
+        addr = targets[i % len(targets)]
+        return addr, addr != primary
 
     def _await_retry(self, deadline: float, attempt: int, what: str) -> None:
         """Between replay rounds: refresh the view; if nothing changed,
@@ -660,6 +744,7 @@ class ClusterClient(ParameterServerClient):
                     lambda: ShardConnection(
                         addr[0], addr[1], window=self._window,
                         timeout=self._timeout,
+                        connect_timeout=self._connect_timeout,
                     ),
                     lines,
                     on_backup_won,
@@ -671,6 +756,70 @@ class ClusterClient(ParameterServerClient):
                 raise
             self._drop_conn(shard)
             raise _Rejected(sids) from None
+
+    def _read_frames(
+        self, shard: int, sids: np.ndarray, lines: List[str], *,
+        trace=None,
+    ) -> List[str]:
+        """Route one shard's READ frames: a replica when the rotation
+        picks one, the primary otherwise — and always the primary as
+        the fallback when the replica declines (lagging/not-primary)
+        or cannot be reached.  Pulls are idempotent, so the fallback
+        replays the whole frame set."""
+        addr, is_replica = self._read_target(shard)
+        if not is_replica:
+            return self._request_frames(
+                shard, sids, lines, hedgeable=True, trace=trace
+            )
+        resps = None
+        try:
+            resps = self._replica_request(shard, addr, lines, trace)
+        except OSError:
+            self._drop_addr(addr)
+        if resps is not None and not any(
+            _is_follower_reject(r) for r in resps
+        ):
+            if self._c_replica_reads is not None:
+                self._c_replica_reads.inc(len(lines))
+            return resps
+        if self._c_fallbacks is not None:
+            self._c_fallbacks.inc()
+        return self._request_frames(
+            shard, sids, lines, hedgeable=True, trace=trace
+        )
+
+    def _replica_request(
+        self, shard: int, addr: Tuple[str, int], lines: List[str], trace
+    ) -> List[str]:
+        """One replica's frames — hedged, when a hedger is attached,
+        against the PRIMARY: a straggling replica races the shard's
+        write owner and the first answer wins (the budgeted
+        elastic/hedging.py race, re-aimed across the chain)."""
+        conn = self._conn_for_addr(addr)
+        if self.hedge is None:
+            return conn.request_many(lines)
+        primary = self._addresses[shard]
+
+        def on_backup_won(spare_conn):
+            # the spare dialed the primary; it takes the primary's
+            # cache slot (the still-draining replica conn is dropped)
+            old = self._conns.pop(primary, None)
+            if old is not None:
+                old.close()
+            self._conns[primary] = spare_conn
+            self._drop_addr(addr)
+
+        return self.hedge.request_many(
+            conn,
+            lambda: ShardConnection(
+                primary[0], primary[1], window=self._window,
+                timeout=self._timeout,
+                connect_timeout=self._connect_timeout,
+            ),
+            lines,
+            on_backup_won,
+            trace=trace,
+        )
 
     def _pull_shard(
         self, shard: int, ids: np.ndarray, ctx=None
@@ -701,9 +850,7 @@ class ClusterClient(ParameterServerClient):
             ]
             ser_per = (time.perf_counter() - t_ser) / max(1, len(lines))
             t0 = time.perf_counter()
-            resps = self._request_frames(
-                shard, ids, lines, hedgeable=True, trace=trace
-            )
+            resps = self._read_frames(shard, ids, lines, trace=trace)
             # one observation per chunk frame: the pipelined per-frame
             # turnaround, amortised (total wall / frames)
             per = (time.perf_counter() - t0) / max(1, len(lines))

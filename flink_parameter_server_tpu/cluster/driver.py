@@ -78,6 +78,10 @@ class ClusterConfig:
     supervised: bool = True  # ShardServer restart supervision
     host: str = "127.0.0.1"
     request_timeout: float = 30.0
+    # dial deadline, separate from the read deadline above: failure
+    # detection (elastic replacement, replica failover) must not sit
+    # behind a 30 s connect to a dead address
+    connect_timeout: float = 5.0
     # distributed tracing (telemetry/distributed.py): one SpanTracer
     # ring per shard server + one for the clients, pull/push frames
     # stamped with t=<trace>:<span> tokens; collect the rings with
@@ -259,6 +263,7 @@ class ClusterDriver:
             window=cfg.window,
             chunk=cfg.chunk,
             timeout=cfg.request_timeout,
+            connect_timeout=cfg.connect_timeout,
             wire_format=cfg.wire_format,
             registry=self.registry if self.registry is not None else False,
             worker=worker,

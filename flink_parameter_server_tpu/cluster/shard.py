@@ -21,6 +21,9 @@ contract for observability options)::
     push <id1,id2,...> <payload> [pid=<t>] [e=<n>] [t=<tok>]  # deltas
     xfer <id1,id2,...> [t=<tok>]             # atomic (rows, seq) snapshot
     load <id1,id2,...> <payload>             # row ASSIGNMENT (migration)
+    repl <b64-frame> [head=<n>]              # ship one WAL record to a
+                                             # follower (replication/)
+    replstate                                # one-line JSON repl state
     flush                                    # fsync the WAL, ack counters
     stats                                    # one-line JSON shard stats
     conns                                    # live connection ledger
@@ -30,9 +33,11 @@ contract for observability options)::
     ok applied=<k> seq=<n>                # push answer
     ok n=<k> seq=<s> <payload>            # xfer answer (always b64)
     ok loaded=<k> seq=<n>                 # load answer
+    ok acked seg=<s> seq=<n>              # repl answer (the follower ack:
+                                          # durable segment + end seq)
     ok pushes=<n> wal_records=<m>         # flush answer
     err <reason>      # bad-request | crashed | stale-epoch | frozen
-                      # | internal
+                      # | lagging | not-primary | internal
 
 Epoch fencing (the elastic/ membership protocol, docs/elastic.md): a
 shard pins the partition-map epoch it serves.  A push whose frame
@@ -125,6 +130,26 @@ class FrozenKeys(RuntimeError):
     shortly; the epoch flip that re-homes the range is imminent."""
 
 
+class NotPrimary(RuntimeError):
+    """A write landed on a replica-chain follower.  Followers absorb
+    reads only; the client must route writes to the primary
+    (``err not-primary`` on the wire)."""
+
+
+class FollowerLagging(RuntimeError):
+    """A follower's applied state trails the primary's head past the
+    read-staleness bound, so serving this read would violate the SSP
+    contract — the client falls back to the primary
+    (``err lagging lag=<n>`` on the wire)."""
+
+    def __init__(self, lag: int):
+        super().__init__(
+            f"follower is {lag} records behind the primary head "
+            f"(past the staleness bound)"
+        )
+        self.lag = int(lag)
+
+
 def format_rows(rows: np.ndarray, encoding: str = "text") -> str:
     """Encode fp32 rows for the wire (see module docstring): ``text``
     uses per-float ``repr`` (exact, human-readable), ``b64`` base64s
@@ -208,6 +233,12 @@ class ParamShard:
         self.shard_id = int(shard_id)
         self.partitioner = partitioner
         self.value_shape = tuple(int(s) for s in value_shape)
+        # replica-chain role (replication/): a primary absorbs writes
+        # and may ship its WAL records to followers via an attached
+        # sink; followers override the write surface (see
+        # replication/follower.ReplicaShard)
+        self.role = "primary"
+        self._repl_sink = None
         self._init_fn = init_fn
         self._dtype = dtype
         self.owned = partitioner.owned_ids(self.shard_id)
@@ -539,6 +570,7 @@ class ParamShard:
                     payload["pid"] = pid
                 with prof.timer("push", "wal_append"):
                     self._wal.append(self._push_seq, 1, payload)
+                self._repl_offer(self._push_seq, 1, payload)
             self._push_seq += 1
             with prof.timer("push", "scatter_apply"):
                 self._apply(ids, deltas)
@@ -611,10 +643,9 @@ class ParamShard:
                     f"{len(ids)} ids but {len(values)} value rows"
                 )
             if self._wal is not None:
-                self._wal.append(
-                    self._push_seq, 1,
-                    {"kind": "load", "ids": ids, "values": values},
-                )
+                payload = {"kind": "load", "ids": ids, "values": values}
+                self._wal.append(self._push_seq, 1, payload)
+                self._repl_offer(self._push_seq, 1, payload)
             self._push_seq += 1
             self._assign(ids, values)
             self.loads_applied += int(len(ids))
@@ -681,15 +712,14 @@ class ParamShard:
             self.epoch = int(epoch)
             if self._wal is not None:
                 barrier = self._push_seq
-                self._wal.append(
-                    barrier, 1,
-                    {
-                        "kind": "snapshot",
-                        "ids": new_owned,
-                        "values": rows,
-                        "pairs": list(self._applied_pairs),
-                    },
-                )
+                payload = {
+                    "kind": "snapshot",
+                    "ids": new_owned,
+                    "values": rows,
+                    "pairs": list(self._applied_pairs),
+                }
+                self._wal.append(barrier, 1, payload)
+                self._repl_offer(barrier, 1, payload)
                 self._push_seq += 1
                 # older segments are fully superseded by the barrier —
                 # best-effort bound on the log (whole segments only)
@@ -757,6 +787,75 @@ class ParamShard:
             return []
         return self._wal.replay_range(after_seq, global_ids)
 
+    # -- replica chains (replication/, docs/elastic.md) ----------------------
+    def attach_repl_sink(self, sink) -> None:
+        """Attach the replication fan-out: every WAL record this shard
+        appends from here on is also handed to ``sink.offer(start,
+        n_steps, payload)`` — the primary half of the ``repl`` stream.
+        The sink must be non-blocking (it is called under the shard
+        lock); the :class:`~..replication.shipper.ReplHub` queues and
+        lets shipper threads do the socket work."""
+        with self._lock:
+            self._repl_sink = sink
+
+    def detach_repl_sink(self) -> None:
+        with self._lock:
+            self._repl_sink = None
+
+    def _repl_offer(self, start_step: int, n_steps: int, payload) -> None:
+        sink = self._repl_sink
+        if sink is not None:
+            try:
+                sink.offer(start_step, n_steps, payload)
+            except Exception:  # replication must never fail a write
+                pass
+
+    def head_seq(self) -> int:
+        """The primary's current push-sequence head — what a follower's
+        lag is measured against (rides ``repl`` frames as ``head=``)."""
+        with self._lock:
+            return self._push_seq
+
+    def repl_backlog(self, after_seq: int) -> list:
+        """The shippable WAL tail: records with ``end_step >
+        after_seq``, starting no earlier than the newest snapshot
+        barrier (a snapshot supersedes everything before it — shipping
+        pre-barrier records to a follower built under the current map
+        would reference ids it cannot route).  The shipper's resync
+        path: bootstrap (``after_seq=-1``) and reconnect both land
+        here."""
+        if self._wal is None:
+            return []
+        records = self._wal.replay()
+        start = 0
+        for i, rec in enumerate(records):
+            p = rec.payload
+            if isinstance(p, dict) and p.get("kind") == "snapshot":
+                start = i
+        return [r for r in records[start:] if r.end_step > after_seq]
+
+    def apply_repl(self, record, head=None) -> dict:
+        """Receive one shipped WAL record (the ``repl`` verb).  Only a
+        follower accepts the stream; the base (primary) shard rejects
+        it as a routing error — see
+        :class:`~..replication.follower.ReplicaShard`."""
+        raise ValueError(
+            f"shard {self.shard_id} is a {self.role}, not a replication "
+            f"follower — repl frames route to followers only"
+        )
+
+    def repl_state(self) -> dict:
+        """One-line replication state (the ``replstate`` verb): role +
+        the sequence cursors a failover decision reads.  Followers
+        override with their lag figures."""
+        with self._lock:
+            return {
+                "shard": self.shard_id,
+                "role": self.role,
+                "seq": self._push_seq,
+                "epoch": self.epoch,
+            }
+
     # -- failure / recovery -------------------------------------------------
     def crash(self) -> None:
         """Chaos hook: drop the in-memory slice (the WAL survives — it
@@ -782,6 +881,7 @@ class ParamShard:
         with self._lock:
             return {
                 "shard": self.shard_id,
+                "role": self.role,
                 "rows": int(len(self.owned)),
                 "pulls": self.pulls_served,
                 "pushes": self.pushes_applied,
@@ -903,6 +1003,10 @@ class ShardServer(LineServer):
                 return f"err stale-epoch epoch={e.shard_epoch}"
             except FrozenKeys:
                 return "err frozen"
+            except FollowerLagging as e:
+                return f"err lagging lag={e.lag}"
+            except NotPrimary:
+                return "err not-primary"
             except (ValueError, KeyError) as e:
                 return f"err bad-request: {e}"
             except Exception as e:  # noqa: BLE001 — protocol boundary
@@ -1016,6 +1120,31 @@ class ShardServer(LineServer):
             self._parse_opts(toks[3:])  # validate; load is controller-driven
             seq = self.shard.assign_rows(ids, vals)
             return f"ok loaded={len(ids)} seq={seq}"
+        if cmd == "repl":
+            # the replication stream (replication/shipper.py): one WAL
+            # record, CRC-framed exactly as on disk, applied by a
+            # follower; the response line IS the (segment, seq) ack
+            if len(toks) < 2:
+                raise ValueError("usage: repl <b64-frame> [head=<n>]")
+            from ..resilience.wal import decode_frame
+
+            opts = self._parse_opts(toks[2:])
+            head = opts.get("head")
+            if head is not None:
+                try:
+                    head = int(head)
+                except ValueError:
+                    raise ValueError(
+                        f"head={head!r}: must be an integer"
+                    ) from None
+            rec = decode_frame(toks[1])
+            ack = self.shard.apply_repl(rec, head=head)
+            return (
+                f"ok acked seg={ack['seg']} seq={ack['seq']} "
+                f"applied={ack['applied']}"
+            )
+        if cmd == "replstate":
+            return "ok " + json.dumps(self.shard.repl_state())
         if cmd == "flush":
             f = self.shard.flush()
             return f"ok pushes={f['pushes']} wal_records={f['wal_records']}"
@@ -1027,7 +1156,7 @@ class ShardServer(LineServer):
             return "ok " + json.dumps(self.conn_table())
         raise ValueError(
             f"unknown command {cmd!r} "
-            f"(pull|push|xfer|load|flush|stats|conns)"
+            f"(pull|push|xfer|load|repl|replstate|flush|stats|conns)"
         )
 
 
@@ -1037,6 +1166,8 @@ __all__ = [
     "ShardCrashed",
     "StaleEpoch",
     "FrozenKeys",
+    "NotPrimary",
+    "FollowerLagging",
     "format_rows",
     "parse_rows",
     "parse_ids",

@@ -134,6 +134,7 @@ class ElasticClusterDriver(ClusterDriver):
             window=cfg.window,
             chunk=cfg.chunk,
             timeout=cfg.request_timeout,
+            connect_timeout=getattr(cfg, "connect_timeout", 5.0),
             wire_format=cfg.wire_format,
             registry=self.registry if self.registry is not None else False,
             worker=worker,
@@ -268,6 +269,8 @@ class ElasticClusterDriver(ClusterDriver):
             verify=getattr(cfg, "verify_migrations", True),
             registry=self.registry,
             tracer=self.client_tracer,
+            timeout=cfg.request_timeout,
+            connect_timeout=getattr(cfg, "connect_timeout", 5.0),
         )
         epoch = self.membership.current().epoch + 1
         for sh in shards:
@@ -383,8 +386,11 @@ class ElasticController:
 
     Decision order per evaluation (first match wins):
 
-      1. a dead shard → ``replace`` (ignores cooldown — a dead shard
-         is degrading every batch that routes to it);
+      1. a dead (or heartbeat-silent) shard → ``promote`` when the
+         driver has a replica chain for it (O(lag) failover,
+         replication/failover.py), else ``replace`` (O(log) WAL
+         rebuild) — both ignore cooldown, a dead shard is degrading
+         every batch that routes to it;
       2. windowed pull p99 / max queue depth / staleness spread above
          the scale-out thresholds → ``scale_out`` (until
          ``max_shards``);
@@ -473,6 +479,12 @@ class ElasticController:
         n = self.driver.partitioner.num_shards
         for s in range(n):
             if not self.driver.shard_alive(s):
+                # a dead/heartbeat-silent primary with a replica chain
+                # is PROMOTED over (replication/failover.py — O(lag)),
+                # not rebuilt from its full WAL (replace — O(log))
+                can_promote = getattr(self.driver, "can_promote", None)
+                if can_promote is not None and can_promote(s):
+                    return {"action": "promote", "shard": s}
                 return {"action": "replace", "shard": s}
         p99, frames = self._windowed_rtt_p99()
         depth = self._max_queue_depth()
@@ -522,7 +534,7 @@ class ElasticController:
             return None
         now = time.monotonic()
         if (
-            decision["action"] != "replace"
+            decision["action"] not in ("replace", "promote")
             and now - self._last_action_t < self.policy.cooldown_s
         ):
             return None
@@ -531,6 +543,12 @@ class ElasticController:
                 decision["replayed"] = self.driver.replace_shard(
                     decision["shard"]
                 )
+            elif decision["action"] == "promote":
+                report = self.driver.promote_shard(decision["shard"])
+                decision["follower"] = report.follower
+                decision["failover_seconds"] = report.failover_seconds
+                decision["records_caught_up"] = report.records_caught_up
+                decision["records_salvaged"] = report.records_salvaged
             elif decision["action"] == "scale_out":
                 decision["report_rows"] = self.driver.scale_out().rows_moved
             elif decision["action"] == "scale_in":

@@ -172,6 +172,8 @@ def execute_moves(
     verify: bool = True,
     registry=None,
     tracer=None,
+    timeout: float = 30.0,
+    connect_timeout: float = 5.0,
 ) -> MigrationReport:
     """Run the migration protocol for every move; the caller flips the
     epoch afterwards (sources stay frozen until then).  ``shards_by_id``
@@ -217,7 +219,10 @@ def execute_moves(
     def conn(shard_id: int) -> ShardConnection:
         if shard_id not in conns:
             host, port = addr_by_id[shard_id]
-            conns[shard_id] = ShardConnection(host, port, window=8)
+            conns[shard_id] = ShardConnection(
+                host, port, window=8, timeout=timeout,
+                connect_timeout=connect_timeout,
+            )
         return conns[shard_id]
 
     by_src: Dict[int, List[Move]] = {}

@@ -57,6 +57,15 @@ class Fault:
     "source_error" (raise from the source at batch index ``at``),
     "delay" (sleep ``delay_ms`` before yielding batch ``at``),
     "disconnect" (raise ConnectionResetError from the source at ``at``).
+
+    Replication-stream kinds (keyed by SHIPPED-RECORD ordinal, fired
+    through :meth:`FaultPlan.shipper_hook`): "repl_drop" (sever the
+    repl connection — the resync path re-ships, delivery is delayed
+    never lost), "repl_delay" (sleep ``delay_ms`` before the ship),
+    "repl_partition" (pause the stream ``delay_ms`` — follower lag
+    grows past the staleness bound and reads shed to the primary),
+    "kill_primary" (invoke the caller's kill callback MID-SHIP, then
+    sever — the failover storyline's crash point).
     """
 
     kind: str
@@ -64,7 +73,10 @@ class Fault:
     delay_ms: float = 0.0
     failure_class: str = "device"
 
-    _KINDS = ("crash", "source_error", "delay", "disconnect")
+    _KINDS = (
+        "crash", "source_error", "delay", "disconnect",
+        "repl_drop", "repl_delay", "repl_partition", "kill_primary",
+    )
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -126,6 +138,24 @@ class FaultPlan:
     def disconnect_at(self, batch: int) -> "FaultPlan":
         return self._with(Fault("disconnect", batch))
 
+    # replication-stream faults (fired via :meth:`shipper_hook`; ``at``
+    # is the shipper's shipped-record ordinal, not a training step)
+    def drop_repl_at(self, record: int) -> "FaultPlan":
+        return self._with(Fault("repl_drop", record))
+
+    def delay_repl_at(self, record: int, delay_ms: float) -> "FaultPlan":
+        return self._with(Fault("repl_delay", record, delay_ms=delay_ms))
+
+    def partition_repl_at(
+        self, record: int, duration_ms: float
+    ) -> "FaultPlan":
+        return self._with(
+            Fault("repl_partition", record, delay_ms=duration_ms)
+        )
+
+    def kill_primary_at(self, record: int) -> "FaultPlan":
+        return self._with(Fault("kill_primary", record))
+
     @classmethod
     def from_seed(
         cls,
@@ -167,6 +197,40 @@ class FaultPlan:
                         f"(scheduled at {f.at})",
                         failure_class=f.failure_class,
                     )
+
+        return hook
+
+    def shipper_hook(self, on_kill_primary=None):
+        """A :class:`~..replication.shipper.WALShipper` fault hook:
+        called with each shipped record's ordinal, returns the action
+        the shipper must take (``"drop"`` severs the stream) or None.
+        Delays and partitions sleep HERE (the shipper's thread — the
+        stream itself stalls, exactly like a slow or partitioned
+        link); ``kill_primary`` fires ``on_kill_primary()`` mid-ship.
+        Fired-once bookkeeping is the plan-wide set, like every other
+        hook: a resynced stream does not replay the incident."""
+        fired = self._fired()
+
+        def hook(record_idx: int):
+            action = None
+            for i, f in enumerate(self.faults):
+                if i in fired or f.kind not in (
+                    "repl_drop", "repl_delay", "repl_partition",
+                    "kill_primary",
+                ) or record_idx < f.at:
+                    continue
+                fired.add(i)
+                if f.kind == "repl_delay":
+                    time.sleep(f.delay_ms / 1e3)
+                elif f.kind == "repl_partition":
+                    time.sleep(f.delay_ms / 1e3)
+                elif f.kind == "repl_drop":
+                    action = "drop"
+                elif f.kind == "kill_primary":
+                    if on_kill_primary is not None:
+                        on_kill_primary()
+                    action = "drop"
+            return action
 
         return hook
 
@@ -307,6 +371,12 @@ class ChaosLineServer:
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            # shutdown-first: close() does not wake a blocked accept()
+            # on Linux (see utils/net.LineServer.stop)
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

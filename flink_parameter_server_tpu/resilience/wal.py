@@ -36,6 +36,7 @@ checkpoint callback) — one lock covers both.
 """
 from __future__ import annotations
 
+import base64
 import dataclasses
 import io
 import os
@@ -67,6 +68,45 @@ class WALRecord:
     @property
     def end_step(self) -> int:
         return self.start_step + self.n_steps
+
+
+def encode_frame(start_step: int, n_steps: int, payload: Any) -> str:
+    """One WAL record as a wire-safe token: the exact on-disk framing
+    (``REC_MAGIC`` + header + CRC32 + pickled payload), base64'd.  This
+    is the replication stream's unit (``repl`` verb, cluster/shard.py):
+    the same CRC that guards a segment against a torn tail guards a
+    shipped record against wire corruption."""
+    blob = pickle.dumps(payload, protocol=4)
+    frame = (
+        REC_MAGIC
+        + _REC_HDR.pack(0, int(start_step), int(n_steps), len(blob),
+                        zlib.crc32(blob))
+        + blob
+    )
+    return base64.b64encode(frame).decode("ascii")
+
+
+def decode_frame(token: str) -> WALRecord:
+    """Inverse of :func:`encode_frame`; raises ``ValueError`` on a bad
+    magic, short frame, or CRC mismatch (a corrupt shipped record must
+    be rejected at the wire, never applied)."""
+    try:
+        raw = base64.b64decode(token.encode("ascii"), validate=True)
+    except Exception as e:
+        raise ValueError(f"repl frame is not valid base64: {e}") from None
+    hdr_len = len(REC_MAGIC) + _REC_HDR.size
+    if len(raw) < hdr_len or raw[: len(REC_MAGIC)] != REC_MAGIC:
+        raise ValueError("repl frame: bad record magic")
+    seq, start, n_steps, plen, crc = _REC_HDR.unpack(
+        raw[len(REC_MAGIC): hdr_len]
+    )
+    blob = raw[hdr_len:]
+    if len(blob) != plen or zlib.crc32(blob) != crc:
+        raise ValueError(
+            f"repl frame: CRC mismatch ({len(blob)} of {plen} payload "
+            f"bytes)"
+        )
+    return WALRecord(seq, start, n_steps, pickle.loads(blob))
 
 
 class UpdateWAL:
@@ -419,4 +459,4 @@ class UpdateWAL:
         self.close()
 
 
-__all__ = ["UpdateWAL", "WALRecord"]
+__all__ = ["UpdateWAL", "WALRecord", "encode_frame", "decode_frame"]

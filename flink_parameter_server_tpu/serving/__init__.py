@@ -25,6 +25,9 @@ Module map::
                 protocol, symmetric to data/socket.py's ingest edge)
   metrics.py    ServingMetrics — QPS, batch-fill ratio, queue depth,
                 p50/p99 request latency, snapshot staleness
+  follower.py   FollowerLookupService — serving lookups routed across
+                replica chains (replication/): reads survive a dead
+                primary and a mid-flight failover
 
 Train-while-serve is one call::
 
@@ -36,11 +39,14 @@ Train-while-serve is one call::
 """
 from .batcher import QueueFull, RequestBatcher
 from .engine import LookupResult, NoSnapshotError, QueryEngine, TopKResult
+from .follower import ChainLookupResult, FollowerLookupService
 from .metrics import ServingMetrics
 from .server import ServingClient, ServingServer, ServingService
 from .snapshot import SnapshotManager, TableSnapshot
 
 __all__ = [
+    "ChainLookupResult",
+    "FollowerLookupService",
     "QueueFull",
     "RequestBatcher",
     "NoSnapshotError",

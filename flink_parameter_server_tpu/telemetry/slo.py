@@ -91,12 +91,23 @@ def recovery_time_slo(threshold_s: float = 5.0,
                    threshold_s, target)
 
 
+def failover_slo(threshold_s: float = 1.0,
+                 target: float = 0.95) -> SLOSpec:
+    """Replica-chain failovers (``replication_failover_seconds``,
+    observed per promotion by replication/failover.py) — the
+    sub-second availability budget docs/elastic.md promises: 95% of
+    primary losses resolved by a follower flip within a second."""
+    return SLOSpec("failover_time", "replication_failover_seconds",
+                   threshold_s, target)
+
+
 def default_slos() -> List[SLOSpec]:
     return [
         pull_latency_slo(),
         serving_latency_slo(),
         staleness_slo(),
         recovery_time_slo(),
+        failover_slo(),
     ]
 
 
@@ -322,6 +333,7 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "default_slos",
+    "failover_slo",
     "pull_latency_slo",
     "recovery_time_slo",
     "serving_latency_slo",

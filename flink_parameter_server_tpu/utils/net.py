@@ -244,6 +244,17 @@ class LineServer:
     def stop(self) -> None:
         self._stop.set()
         try:
+            # close() alone does NOT wake a thread blocked in accept()
+            # on Linux — the fd vanishes but the wait continues, and
+            # every stop then eats the full accept-join timeout below
+            # (measured: a flat 5 s per server teardown across the
+            # suite).  shutdown() makes the blocked accept return
+            # immediately (EINVAL), same trick as the per-connection
+            # sockets.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -412,16 +423,24 @@ def request_lines(
     port: int,
     lines,
     timeout: float = 30.0,
+    connect_timeout: Optional[float] = None,
 ) -> List[str]:
     """Pipelined client helper: send every request line on ONE
     connection, then read exactly one response line per request (the
     line-protocol ordering contract).  Returns the response lines.
     Bytes/frames are counted into the client-role wire ledger
     (``net_bytes_total{role="client"}``), attributed per request verb
-    — responses positionally, per the ordering contract."""
+    — responses positionally, per the ordering contract.
+
+    ``timeout`` is the per-read deadline once connected;
+    ``connect_timeout`` (default: same as ``timeout``) bounds the dial
+    separately — a liveness probe against a dead host must fail in its
+    own budget, not the read's."""
     reqs = [ln.strip() for ln in lines]
     meter = client_meter()
-    with socket.create_connection((host, port), timeout=timeout) as s:
+    dial = timeout if connect_timeout is None else float(connect_timeout)
+    with socket.create_connection((host, port), timeout=dial) as s:
+        s.settimeout(timeout)
         for ln in reqs:
             meter.count("out", _safe_verb(ln), len(ln) + 1)
         s.sendall(("\n".join(reqs) + "\n").encode("utf-8"))

@@ -59,7 +59,8 @@ MODULE_SYMBOLS = {
         "set_recorder"],
     "flink_parameter_server_tpu.telemetry.slo": [
         "SLOEngine", "SLOSpec", "default_slos", "pull_latency_slo",
-        "serving_latency_slo", "staleness_slo", "recovery_time_slo"],
+        "serving_latency_slo", "staleness_slo", "recovery_time_slo",
+        "failover_slo"],
     "flink_parameter_server_tpu.telemetry.profiler": [
         "PhaseProfiler", "StackSampler", "PHASES", "get_profiler",
         "set_profiler", "resolve_profiler"],
@@ -104,6 +105,16 @@ MODULE_SYMBOLS = {
         "ElasticController", "ScalePolicy", "MembershipService",
         "PartitionEpoch", "plan_moves", "execute_moves", "Hedger",
         "HedgeBudget"],
+    "flink_parameter_server_tpu.replication": [
+        "ReplicatedClusterConfig", "ReplicatedClusterDriver",
+        "ReplicaShard", "ReplicaChain", "ChainManager", "WALShipper",
+        "ReplHub", "PromoteReport", "promote"],
+    "flink_parameter_server_tpu.replication.failover": [
+        "salvage_records", "verify_against_log"],
+    "flink_parameter_server_tpu.resilience.wal": [
+        "UpdateWAL", "WALRecord", "encode_frame", "decode_frame"],
+    "flink_parameter_server_tpu.serving.follower": [
+        "FollowerLookupService", "ChainLookupResult"],
     "flink_parameter_server_tpu.data.movielens": [
         "synthetic_ratings", "load_movielens"],
     "flink_parameter_server_tpu.data.text": [

@@ -6,7 +6,8 @@ round-stamped ``BENCH_r0*.json`` captures at the repo root (``{"n":
 <round>, "parsed": {"metric", "value", "unit", ...}}``) and the
 benchmark suites' ``results/<platform>/*.json`` artifacts
 (``{"captured_at": ..., "payload": {"metric", "value", "unit", ...}}``
-— cluster_scaling, elastic_scaling, recovery_time, serving_qps, ...).
+— cluster_scaling, elastic_scaling, recovery_time, serving_qps,
+failover_time, ...).
 Until this tool, comparing a metric across rounds meant opening each
 file by hand — so regressions slid by unless someone remembered the
 old number.  This folds them all into one metric × round table and

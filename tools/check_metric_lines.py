@@ -58,7 +58,8 @@ from typing import Any, Iterable, List, Tuple
 # the HealthMonitor heartbeat component (resilience/health.py SERVING).
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
-     "serving_dispatch", "elastic", "slo", "profiler", "net"}
+     "serving_dispatch", "elastic", "slo", "profiler", "net",
+     "replication"}
 )
 
 

@@ -84,6 +84,8 @@ def default_drift_config(root: str) -> DriftConfig:
                     f"{pkg}/elastic/migration.py",
                     f"{pkg}/elastic/controller.py",
                     f"{pkg}/elastic/hedging.py",
+                    f"{pkg}/replication/shipper.py",
+                    f"{pkg}/replication/chain.py",
                     "tools/psctl.py",
                 ],
                 ("docs/cluster.md", "wire-verbs shard"),
