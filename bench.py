@@ -957,6 +957,55 @@ def _emit_failover_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_nemesis_metric(platform: str, fallback: bool) -> None:
+    """Eighth (opt-in) metric line: the nemesis fault-injection battery.
+
+    FPS_BENCH_NEMESIS=1 replays the committed fixed-seed scenario
+    corpus (benchmarks/nemesis_battery.py: chaos-proxied cluster,
+    composed network+cluster faults, invariant checkers) and writes
+    ``results/<platform>/nemesis.{md,json}`` — the artifact any
+    robustness claim should cite (docs/resilience.md fault-model
+    matrix).  Default 0 (the battery costs tens of seconds); failure
+    degrades to a value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_NEMESIS", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_NEMESIS={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "nemesis scenario battery (fixed-seed fault injection)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.nemesis_battery import run_nemesis_bench
+
+        r = run_nemesis_bench()
+        print(json.dumps({
+            "metric": metric,
+            "value": r["scenarios_passed"],
+            "unit": "scenarios passed",
+            "extra": {
+                "scenarios_run": r["scenarios_run"],
+                "scenarios_passing_expected":
+                    r["scenarios_passing_expected"],
+                "scenarios_passed": r["scenarios_passed"],
+                "violations_seeded": r["violations_seeded"],
+                "violations_caught": r["violations_caught"],
+                "corpus_replay_ok": r["corpus_replay_ok"],
+                "fault_classes": r["fault_classes"],
+                "faults_injected": r["faults_injected"],
+                "wall_s": r["wall_s"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "scenarios passed",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -985,6 +1034,7 @@ def main():
             _emit_cluster_metric(platform, fallback)
             _emit_elastic_metric(platform, fallback)
             _emit_failover_metric(platform, fallback)
+            _emit_nemesis_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1040,6 +1090,7 @@ def main():
     _emit_cluster_metric(platform, fallback)
     _emit_elastic_metric(platform, fallback)
     _emit_failover_metric(platform, fallback)
+    _emit_nemesis_metric(platform, fallback)
 
 
 if __name__ == "__main__":

@@ -82,7 +82,12 @@ from ..ops.dedup import aggregate_deltas, coalesce_ids
 from ..telemetry.distributed import TraceContext, format_token, new_trace
 from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
 from ..telemetry.spans import gen_id
-from ..utils.net import _safe_verb, client_meter
+from ..utils.net import (
+    PeerHalfClosed,
+    _safe_verb,
+    client_meter,
+    count_half_closed,
+)
 from .partition import Partitioner
 from .shard import format_rows, parse_rows
 
@@ -157,10 +162,23 @@ class ShardConnection:
                 self.inflight = pending
                 self.requests_sent += 1
             raw = self._rfile.readline()
-            if not raw:
-                raise ConnectionError(
+            if not raw or not raw.endswith(b"\n"):
+                # empty read = peer half-close: the shard is GONE (died,
+                # was replaced, RST mid-frame), not merely slow — a slow
+                # shard surfaces as socket.timeout from the readline.
+                # A NON-EMPTY read without its newline is the same event
+                # one packet earlier: the peer died MID-FRAME and
+                # readline returned the torn prefix at EOF — treating
+                # that prefix as a response line would hand a truncated
+                # payload to the parser (or worse, a truncated "ok ..."
+                # to _check_ok).  Distinct retryable type + counted, so
+                # the elastic retry path (and the operator) can tell a
+                # dead peer from a slow one.
+                count_half_closed("client")
+                raise PeerHalfClosed(
                     f"shard {self.host}:{self.port} closed mid-pipeline "
-                    f"({len(out)}/{total} responses)"
+                    f"({len(out)}/{total} responses"
+                    + (", torn frame" if raw else "") + ")"
                 )
             self._meter.count("in", pending_verbs.pop(0), len(raw))
             out.append(raw.decode("utf-8", "replace").rstrip("\n"))
@@ -255,6 +273,7 @@ class ClusterClient(ParameterServerClient):
         hedge=None,
         retry_timeout: float = 30.0,
         retry_sleep_s: float = 0.002,
+        retry_sleep_cap_s: float = 0.05,
         tracer=None,
         flightrec=None,
         storm_threshold: int = 25,
@@ -307,6 +326,17 @@ class ClusterClient(ParameterServerClient):
         self._rr: Dict[int, int] = {}
         self.retry_timeout = float(retry_timeout)
         self.retry_sleep_s = float(retry_sleep_s)
+        self.retry_sleep_cap_s = float(retry_sleep_cap_s)
+        # retry backoff state: decorrelated-jitter sleeps need the
+        # previous draw, and each client needs its OWN stream — a herd
+        # of workers replaying into a recovering shard must disperse,
+        # not arrive in lockstep (the retry-storm fix; the jitter shape
+        # is resilience/recovery.py's, decorrelated per AWS)
+        self._retry_rng = np.random.default_rng(
+            (os.getpid() << 16) ^ (id(self) & 0xFFFF_FFFF)
+            ^ (hash(worker) & 0xFFFF if worker is not None else 0)
+        )
+        self._last_retry_sleep: Optional[float] = None
         self._conns: Dict[Tuple[str, int], ShardConnection] = {}
         self.outputs: List[object] = []
         self._pending_pulls: List[int] = []
@@ -457,6 +487,29 @@ class ClusterClient(ParameterServerClient):
         addr = targets[i % len(targets)]
         return addr, addr != primary
 
+    def _next_retry_sleep(self, attempt: int) -> float:
+        """The next replay-round sleep: capped exponential with
+        DECORRELATED jitter — ``uniform(base, min(cap, 3 × previous))``
+        with the exponential ceiling as a floor on the range, capped at
+        ``retry_sleep_cap_s``.
+
+        The predecessor was ``min(0.05, base × (1 + attempt))``:
+        linear, capped at 50 ms, and IDENTICAL across workers — after
+        a partition healed or a shard was replaced, every worker woke
+        on the same schedule and hammered the recovering shard in
+        lockstep (the retry storm the flight recorder kept catching).
+        Per-client seeded draws decorrelate the herd; the cap keeps
+        the worst case at the old 50 ms."""
+        base = max(1e-6, self.retry_sleep_s)
+        cap = self.retry_sleep_cap_s
+        ceiling = min(cap, base * (2 ** min(attempt, 16)))
+        prev = self._last_retry_sleep if self._last_retry_sleep else base
+        hi = min(cap, max(prev * 3.0, ceiling))
+        sleep = float(self._retry_rng.uniform(base, max(base, hi)))
+        sleep = min(cap, sleep)
+        self._last_retry_sleep = sleep
+        return sleep
+
     def _await_retry(self, deadline: float, attempt: int, what: str) -> None:
         """Between replay rounds: refresh the view; if nothing changed,
         sleep briefly (the flip/replacement is in flight) — bounded by
@@ -490,7 +543,7 @@ class ClusterClient(ParameterServerClient):
                 )
                 rec.dump("stale_epoch_storm")
         if not self._refresh_membership():
-            time.sleep(min(0.05, self.retry_sleep_s * (1 + attempt)))
+            time.sleep(self._next_retry_sleep(attempt))
 
     # -- the batch surface --------------------------------------------------
     def _trace_root(self, name: str):
@@ -518,6 +571,7 @@ class ClusterClient(ParameterServerClient):
         todo = unique
         deadline = time.monotonic() + self.retry_timeout
         attempt = 0
+        self._last_retry_sleep = None  # fresh backoff ladder per batch
         ctx, root_span = self._trace_root("pull_batch")
         with root_span:
             while todo.size:
@@ -571,6 +625,7 @@ class ClusterClient(ParameterServerClient):
         todo_ids, todo_rows = unique, summed
         deadline = time.monotonic() + self.retry_timeout
         attempt = 0
+        self._last_retry_sleep = None  # fresh backoff ladder per batch
         ctx, root_span = self._trace_root("push_batch")
         with root_span:
             while todo_ids.size:

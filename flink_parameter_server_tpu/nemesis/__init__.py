@@ -1,0 +1,56 @@
+"""nemesis/ — network-level fault injection + cluster invariant checking.
+
+A Jepsen-lite for the parameter-server cluster (docs/resilience.md
+"Fault-model matrix"): every robustness claim the stack makes —
+exactly-once updates across retries, parity with a fault-free run,
+SSP staleness bounds, sub-second failover — becomes a *checked
+invariant under composed network faults* instead of an anecdote.
+
+  * :mod:`.proxy` — :class:`ChaosProxy`, a seeded byte-level TCP chaos
+    proxy fronting any ``LineServer`` (shard, serving, repl leg):
+    partitions (one-way and two-way), delay/jitter, bandwidth drip,
+    frame duplication/reorder, mid-frame truncation + RST, half-open
+    accepts;
+  * :mod:`.scenarios` — the scenario DSL: network faults composed with
+    cluster operations (kill-primary-under-partition,
+    scale-out-during-drip, promote-while-client-partitioned, straggler
+    storms), serializable to a canonical JSON schedule;
+  * :mod:`.invariants` — the checkers: exactly-once ledger audit,
+    final-table parity vs a fault-free oracle, SSP staleness bound,
+    serving error budget, zero leaked threads, zero lock inversions;
+  * :mod:`.runner` — proxied cluster drivers (every client↔shard byte
+    crosses the mesh), the scenario executor, a randomized scenario
+    search whose failures are reproducible from ``(seed, schedule)``,
+    a shrinker that minimizes failing schedules, and the committed
+    regression corpus (``nemesis/corpus/``) replayed in tier-1.
+"""
+from .invariants import Verdict
+from .proxy import ChaosProxy, ProxiedServer
+from .runner import (
+    NemesisElasticDriver,
+    NemesisReplicatedDriver,
+    ScenarioReport,
+    load_corpus,
+    replay_corpus,
+    run_scenario,
+    search_scenarios,
+    shrink,
+)
+from .scenarios import BUILTIN_SCENARIOS, NemesisOp, Scenario
+
+__all__ = [
+    "BUILTIN_SCENARIOS",
+    "ChaosProxy",
+    "NemesisElasticDriver",
+    "NemesisOp",
+    "NemesisReplicatedDriver",
+    "ProxiedServer",
+    "Scenario",
+    "ScenarioReport",
+    "Verdict",
+    "load_corpus",
+    "replay_corpus",
+    "run_scenario",
+    "search_scenarios",
+    "shrink",
+]

@@ -1,0 +1,227 @@
+"""Cluster invariants — what must hold no matter what the network did.
+
+Each checker returns a :class:`Verdict` (name, ok, detail) so the
+runner can report ALL violations, not just the first: a Jepsen-style
+post-mortem starts from the full verdict table.  Checkers are split
+into live probes (sampled while the scenario runs — staleness, serving
+errors) and post-hoc audits (run after teardown — ledger, parity,
+thread leaks, lock order).
+
+The invariants, and why each is the right oracle:
+
+  * **exactly-once ledger** — every unique delta row a worker client
+    counted as acked (``ClusterClient.rows_pushed``) was applied on
+    exactly one shard (``ParamShard.rows_applied``, summed over every
+    shard EVER live, replacements included).  Retries after torn
+    frames/lost acks are deduplicated by the ``(pid, id)`` window, so
+    a fault can add latency but never a lost or double-counted update.
+  * **final-table parity** — the faulted run's assembled table is
+    allclose-equal (fp32) to a fault-free oracle trained on the SAME
+    stream.  This is the end-to-end consistency oracle: anything that
+    silently mis-routed, re-ordered (under BSP), dropped or corrupted
+    an update shows up here even when every counter balances.
+  * **SSP staleness bound** — the live ``fastest − slowest`` spread
+    never exceeds ``bound + 1`` (the clock gates round STARTS, so the
+    momentary completed-round lead legally tops out one past the
+    bound — cluster/clock.py).  For BSP (bound 0) this plus parity is
+    the read-your-last-round guarantee: the barrier admitted no round
+    whose reads missed the previous round's writes.
+  * **serving error budget** — a reader thread issuing pulls through
+    its own membership client across the whole scenario sees at most
+    ``budget`` errors (default 0: faults are latency, never failures).
+  * **no leaked threads** — after teardown every thread the PS stack
+    spawned (shards, pumps, workers, shippers, controllers) is gone;
+    a fault that orphans a handler fails here, not three suites later.
+  * **no lock inversions** — the scenario runs under the
+    :mod:`~..telemetry.lockwitness` capture and the witnessed
+    acquisition order stays cycle-free (the runtime half of fpsanalyze
+    L001).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# thread-name prefixes owned by this package (utils/net.py names
+# handlers "<server>-conn-*", the drivers name their workers, the
+# proxy names its pumps): the leak check is scoped to OUR threads so a
+# persistent jax/orbax pool never false-positives it
+_OWNED_THREAD_PREFIXES = (
+    "shard-", "nemesis-", "cluster-", "elastic-", "repl-", "serving",
+    "chaos", "line-server", "wal-", "hb-", "ship-", "telemetry",
+)
+
+
+@dataclasses.dataclass
+class Verdict:
+    """One invariant's outcome; ``detail`` carries the evidence either
+    way (a passing verdict still says what it measured)."""
+
+    name: str
+    ok: bool
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "ok": self.ok, "detail": self.detail}
+
+
+def check_no_errors(errors: Sequence[str]) -> Verdict:
+    return Verdict(
+        "no_errors",
+        not errors,
+        "clean run" if not errors else "; ".join(errors[:4]),
+    )
+
+
+def check_exactly_once(acked_rows: int, applied_rows: int) -> Verdict:
+    """The ledger audit: client-acked unique delta rows == shard-applied
+    delta rows, summed over every client and every shard ever live."""
+    ok = acked_rows == applied_rows and acked_rows > 0
+    return Verdict(
+        "exactly_once_ledger", ok,
+        f"acked={acked_rows} applied={applied_rows}"
+        + ("" if ok else " — lost or duplicated updates"),
+    )
+
+
+def check_parity(
+    values: np.ndarray,
+    oracle: np.ndarray,
+    *,
+    rtol: float = 1e-4,
+    atol: float = 1e-6,
+) -> Verdict:
+    """Final table vs the fault-free oracle on the same stream (the
+    repo-wide BSP parity tolerance, tests/test_cluster.py)."""
+    if values.shape != oracle.shape:
+        return Verdict(
+            "final_table_parity", False,
+            f"shape {values.shape} vs oracle {oracle.shape}",
+        )
+    err = np.abs(values - oracle)
+    tol = atol + rtol * np.abs(oracle)
+    bad = int((err > tol).sum())
+    return Verdict(
+        "final_table_parity", bad == 0,
+        f"max_abs_err={float(err.max()):.3e} mismatched_elems={bad}",
+    )
+
+
+def check_staleness(
+    samples: Sequence[int], bound: Optional[int]
+) -> Verdict:
+    """Sampled live spread ≤ bound + 1 (see module docstring); async
+    (bound None) always passes — there is no bound to exceed."""
+    worst = max(samples) if samples else 0
+    if bound is None:
+        return Verdict(
+            "ssp_staleness_bound", True,
+            f"async clock, worst observed spread {worst}",
+        )
+    ok = worst <= bound + 1
+    return Verdict(
+        "ssp_staleness_bound", ok,
+        f"worst spread {worst} vs bound {bound} (+1 round in flight)",
+    )
+
+
+def check_serving_budget(
+    served: int, errors: int, *, budget: int = 0
+) -> Verdict:
+    ok = errors <= budget and served > 0
+    return Verdict(
+        "serving_error_budget", ok,
+        f"served={served} errors={errors} budget={budget}",
+    )
+
+
+def check_lock_inversions(inversions) -> Verdict:
+    n = len(inversions)
+    return Verdict(
+        "no_lock_inversions", n == 0,
+        "witnessed order is cycle-free" if n == 0
+        else f"{n} inversion(s): {inversions[0]}",
+    )
+
+
+class ThreadLedger:
+    """Before/after thread accounting for the leak invariant.
+
+    Snapshot before the topology is built; after teardown,
+    :meth:`check` polls (teardown joins run with timeouts) until every
+    package-owned thread born since the snapshot is gone, or the grace
+    window expires — the survivors are the leak."""
+
+    def __init__(self):
+        self._before = {t.ident for t in threading.enumerate()}
+
+    def _leaked(self) -> List[str]:
+        return sorted(
+            t.name for t in threading.enumerate()
+            if t.ident not in self._before and t.is_alive()
+            and t is not threading.current_thread()
+            and t.name.startswith(_OWNED_THREAD_PREFIXES)
+        )
+
+    def check(self, *, grace_s: float = 5.0) -> Verdict:
+        deadline = time.monotonic() + grace_s
+        leaked = self._leaked()
+        while leaked and time.monotonic() < deadline:
+            time.sleep(0.05)
+            leaked = self._leaked()
+        return Verdict(
+            "no_leaked_threads", not leaked,
+            "all package threads joined" if not leaked
+            else f"leaked: {leaked[:6]}",
+        )
+
+
+class StalenessSampler:
+    """Polls ``driver.clock.staleness()`` on its own thread while a
+    scenario runs (the driver swaps in a fresh clock at run start, so
+    the sampler re-reads the attribute every tick)."""
+
+    def __init__(self, driver, interval_s: float = 0.002):
+        self._driver = driver
+        self._interval = float(interval_s)
+        self.samples: List[int] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "StalenessSampler":
+        self._thread = threading.Thread(
+            target=self._loop, name="nemesis-staleness-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            clock = self._driver.clock
+            if clock is not None:
+                try:
+                    self.samples.append(int(clock.staleness()))
+                except Exception:  # clock mid-swap: skip the tick
+                    pass
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+__all__ = [
+    "StalenessSampler",
+    "ThreadLedger",
+    "Verdict",
+    "check_exactly_once",
+    "check_lock_inversions",
+    "check_no_errors",
+    "check_parity",
+    "check_serving_budget",
+    "check_staleness",
+]

@@ -46,6 +46,43 @@ import time
 from typing import Dict, List, Optional
 
 
+class PeerHalfClosed(ConnectionError):
+    """The peer closed its write side mid-conversation (an empty read)
+    — a DEAD peer, categorically different from a SLOW one (which
+    surfaces as ``socket.timeout``).  Before this type existed both
+    collapsed into the same failure path and a client could not tell
+    "reconnect now, the peer is gone" from "wait, the peer is
+    thinking".  Retryable: drop the connection and replay.  Every
+    raise is counted into ``net_half_closed_total{role=}``
+    (``fps_``-prefixed on ``/metrics``)."""
+
+
+_HALF_CLOSED_COUNTERS: Dict[str, tuple] = {}
+_HALF_CLOSED_LOCK = threading.Lock()
+
+
+def count_half_closed(role: str, registry=None) -> None:
+    """Bump the half-close counter for one endpoint role; accounting
+    must never fail the I/O path (a missing telemetry plane is a
+    no-op, same discipline as :class:`NetMeter`).  The handle cache is
+    keyed by registry identity so a test-isolation registry swap does
+    not count into the old plane."""
+    try:
+        from ..telemetry.registry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        with _HALF_CLOSED_LOCK:
+            cached = _HALF_CLOSED_COUNTERS.get(role)
+            if cached is None or cached[0] is not reg:
+                cached = (reg, reg.counter(
+                    "net_half_closed_total", component="net", role=role
+                ))
+                _HALF_CLOSED_COUNTERS[role] = cached
+        cached[1].inc()
+    except Exception:
+        pass
+
+
 def _safe_verb(line: str) -> str:
     """First token of a request line, sanitised for use as a label
     value (bounded cardinality: lowercase word chars, ≤16 chars,
@@ -449,7 +486,11 @@ def request_lines(
         while len(out) < len(reqs):
             chunk = s.recv(1 << 16)
             if not chunk:
-                raise ConnectionError(
+                # empty read = the peer half-closed: a DEAD peer, not a
+                # slow one (a slow peer is socket.timeout, raised by
+                # recv itself) — distinct type, counted
+                count_half_closed("client")
+                raise PeerHalfClosed(
                     f"peer closed after {len(out)}/{len(reqs)} responses"
                 )
             buf += chunk
@@ -467,6 +508,8 @@ __all__ = [
     "ConnStats",
     "LineServer",
     "NetMeter",
+    "PeerHalfClosed",
     "client_meter",
+    "count_half_closed",
     "request_lines",
 ]

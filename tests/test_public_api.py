@@ -66,7 +66,13 @@ MODULE_SYMBOLS = {
         "set_profiler", "resolve_profiler"],
     "flink_parameter_server_tpu.utils.net": [
         "LineServer", "NetMeter", "ConnStats", "client_meter",
-        "request_lines"],
+        "request_lines", "PeerHalfClosed", "count_half_closed"],
+    "flink_parameter_server_tpu.nemesis": [
+        "ChaosProxy", "ProxiedServer", "NemesisOp", "Scenario",
+        "BUILTIN_SCENARIOS", "ScenarioReport", "Verdict",
+        "NemesisElasticDriver", "NemesisReplicatedDriver",
+        "run_scenario", "search_scenarios", "shrink", "load_corpus",
+        "replay_corpus"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",

@@ -86,6 +86,8 @@ def default_drift_config(root: str) -> DriftConfig:
                     f"{pkg}/elastic/hedging.py",
                     f"{pkg}/replication/shipper.py",
                     f"{pkg}/replication/chain.py",
+                    f"{pkg}/nemesis/runner.py",
+                    f"{pkg}/nemesis/scenarios.py",
                     "tools/psctl.py",
                 ],
                 ("docs/cluster.md", "wire-verbs shard"),
