@@ -1006,6 +1006,63 @@ def _emit_nemesis_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_hotcache_metric(platform: str, fallback: bool) -> None:
+    """Ninth (opt-in) metric line: the hot-key lease cache tier.
+
+    FPS_BENCH_HOTCACHE=1 runs the hot-key storm A/B
+    (benchmarks/hotcache_storm.py: 1% of keys take 90% of reads,
+    open-loop at a load beyond the uncached arm's capacity over
+    ChaosProxy-delayed links, tier on vs off) and writes
+    ``results/<platform>/hotcache_storm.{md,json}`` — the artifact any
+    hot-key-tier claim must cite (docs/hotcache.md).  Default 0 (the
+    A/B costs a minute); failure degrades to a value-None line like
+    every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_HOTCACHE", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_HOTCACHE={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "hotcache storm serving p99 (1% keys = 90% reads, tier on)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.hotcache_storm import run_hotcache_bench
+
+        r = run_hotcache_bench()
+        print(json.dumps({
+            "metric": metric,
+            "value": r["on"]["p99_ms"],
+            "unit": "ms",
+            "extra": {
+                "p99_ms_off": r["off"]["p99_ms"],
+                "p99_ms_on": r["on"]["p99_ms"],
+                "p50_ms_off": r["off"]["p50_ms"],
+                "p50_ms_on": r["on"]["p50_ms"],
+                "p99_speedup": r["p99_speedup"],
+                "p50_speedup": r["p50_speedup"],
+                "offered_rps": r["offered_rps"],
+                "capacity_rps_off": r["off"]["capacity_rps"],
+                "capacity_rps_on": r["on"]["capacity_rps"],
+                "wire_bytes_per_request_off":
+                    r["off"]["wire_bytes_per_request"],
+                "wire_bytes_per_request_on":
+                    r["on"]["wire_bytes_per_request"],
+                "wire_bytes_ratio": r["wire_bytes_ratio"],
+                "cache_hit_rate": r["cache_hit_rate"],
+                "nemesis_mid_lease_ok":
+                    r.get("nemesis_mid_lease", {}).get("ok"),
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "ms",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1035,6 +1092,7 @@ def main():
             _emit_elastic_metric(platform, fallback)
             _emit_failover_metric(platform, fallback)
             _emit_nemesis_metric(platform, fallback)
+            _emit_hotcache_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1091,6 +1149,7 @@ def main():
     _emit_elastic_metric(platform, fallback)
     _emit_failover_metric(platform, fallback)
     _emit_nemesis_metric(platform, fallback)
+    _emit_hotcache_metric(platform, fallback)
 
 
 if __name__ == "__main__":

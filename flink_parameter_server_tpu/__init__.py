@@ -65,6 +65,11 @@ from .telemetry import (
     prometheus_text,
     write_run_report,
 )
+from .hotcache import (
+    CachedLookupService,
+    HotRowCache,
+    LeasePolicy,
+)
 from .training.driver import DriverConfig, StreamingDriver
 
 __version__ = "0.1.0"
@@ -102,6 +107,9 @@ __all__ = [
     "ServingServer",
     "ServingService",
     "SnapshotManager",
+    "CachedLookupService",
+    "HotRowCache",
+    "LeasePolicy",
     "UpdateWAL",
     "RecoveringDriver",
     "RestartPolicy",

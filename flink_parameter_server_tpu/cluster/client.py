@@ -53,6 +53,22 @@ addresses, no epoch tags, rejections raise.
 race a budgeted backup connection against a slow shard — first answer
 wins (pulls are idempotent; pushes are never hedged).
 
+Hot-key lease cache (``hotcache=``, docs/hotcache.md): with a
+:class:`~..hotcache.cache.HotRowCache` and a lease policy attached,
+every ``pull_batch`` is one cache **tick**; rows the cache holds
+within its staleness bound are served locally (zero wire), cold
+misses take the normal pull path (hedged, replica-routed), and HOT
+misses are read via the ``lease`` verb — an atomic read + grant that
+makes the shard queue piggybacked ``inv=`` invalidations when any
+other writer touches the key.  The client strips ``inv=`` tokens from
+every response, invalidates its own pushed ids at push time, clears
+the cache on a membership refresh, and best-effort ``revoke``\\ s its
+session at close.  Leases always route to the PRIMARY and are never
+hedged (the grant is a side effect; a race could double-grant
+harmlessly but would waste budget).  Against a pre-hotcache server the
+first ``err bad-request`` flips the client to plain pulls for good —
+the protocol-versioning downgrade path.
+
 Replica-chain read routing (replication/, docs/elastic.md): when the
 membership view carries ``replicas`` (or a static ``replicas=`` is
 passed), pulls round-robin across ``[primary] + followers`` per shard.
@@ -243,6 +259,13 @@ class _Rejected(Exception):
         self.ids = ids
 
 
+class _LeaseUnsupported(Exception):
+    """Internal: the shard answered a ``lease`` frame with
+    ``err bad-request`` — a pre-hotcache server.  The client downgrades
+    to plain pulls for the rest of its life (the PR-6 versioning
+    contract working in the other direction)."""
+
+
 class ClusterClient(ParameterServerClient):
     """Worker-side handle over every shard.
 
@@ -271,6 +294,9 @@ class ClusterClient(ParameterServerClient):
         replicas=None,
         read_replicas: bool = True,
         hedge=None,
+        hotcache=None,
+        lease_policy=None,
+        lease_ttl: int = 16,
         retry_timeout: float = 30.0,
         retry_sleep_s: float = 0.002,
         retry_sleep_cap_s: float = 0.05,
@@ -348,6 +374,18 @@ class ClusterClient(ParameterServerClient):
         # per-batch idempotence token base: unique per client instance
         self._pid_base = f"{os.getpid():x}.{id(self):x}"
         self._pid_counter = itertools.count()
+        # hot-key lease cache (hotcache/, docs/hotcache.md): attached
+        # here or later via attach_hotcache; None = no caching at all
+        self.hotcache = None
+        self.lease_policy = None
+        self._lease_ttl = int(lease_ttl)
+        self._lease_supported = True
+        self._sess: Optional[str] = None
+        self.leases_acquired = 0  # lease frames answered ok
+        if hotcache is not None:
+            self.attach_hotcache(
+                hotcache, lease_policy, lease_ttl=lease_ttl
+            )
         # distributed tracing (telemetry/distributed.py): with a tracer
         # attached, each pull/push batch becomes one trace, each shard
         # request a child span whose id rides the frame as t=<tr>:<sp>
@@ -415,6 +453,35 @@ class ClusterClient(ParameterServerClient):
             else resolve_profiler(profiler)
         )
 
+    # -- hot-key lease cache (hotcache/, docs/hotcache.md) --------------------
+    def attach_hotcache(
+        self, cache, policy=None, *, lease_ttl: int = 16
+    ) -> "ClusterClient":
+        """Attach a :class:`~..hotcache.cache.HotRowCache` (+ lease
+        policy deciding which keys are lease-worthy).  The BSP
+        carve-out is the CALLER's job: a bound-0 worker client must
+        never get a cache (``ClusterDriver`` enforces it — reads must
+        see every previous-round write)."""
+        self.hotcache = cache
+        self.lease_policy = policy
+        self._lease_ttl = int(lease_ttl)
+        self._lease_supported = True
+        # session token: what the shard keys this client's grants and
+        # piggybacked invalidations on (unique per client instance)
+        self._sess = f"c{self._pid_base}"
+        return self
+
+    def _apply_response_options(self, resp: str) -> str:
+        """Strip trailing response options (``inv=`` piggybacks) and
+        apply them to the cache; returns the bare response line."""
+        from ..hotcache.leases import parse_inv_token, split_response_options
+
+        body, opts = split_response_options(resp)
+        inv = opts.get("inv")
+        if inv is not None and self.hotcache is not None:
+            self.hotcache.invalidate(parse_inv_token(inv))
+        return body
+
     # -- observability ------------------------------------------------------
     def inflight(self) -> int:
         """Outstanding pull/push frames across every shard connection —
@@ -465,6 +532,11 @@ class ClusterClient(ParameterServerClient):
                 self._conns.pop(addr).close()
         self._addresses = new_addrs
         self._replicas = new_replicas
+        if self.hotcache is not None:
+            # a resharding may have re-homed any cached key: drop
+            # everything (the shards queued inv=* too — this is the
+            # client-side half of the same conservatism)
+            self.hotcache.clear()
         if self._c_refresh is not None:
             self._c_refresh.inc()
         return True
@@ -569,6 +641,20 @@ class ClusterClient(ParameterServerClient):
         width = int(np.prod(self.value_shape)) if self.value_shape else 1
         flat = np.empty((unique.size, width), dtype)
         todo = unique
+        cache = self.hotcache
+        if cache is not None:
+            # one pull_batch = one cache tick (a worker round / a
+            # serving request); entries within the staleness bound are
+            # served with zero wire, the rest fall through below
+            cache.tick()
+            hits = cache.lookup(unique)
+            if hits:
+                hit_ids = np.fromiter(hits.keys(), np.int64, len(hits))
+                hit_ids.sort()
+                flat[np.searchsorted(unique, hit_ids)] = np.stack(
+                    [hits[int(g)] for g in hit_ids]
+                ).reshape(len(hit_ids), width).astype(dtype)
+                todo = np.setdiff1d(unique, hit_ids, assume_unique=True)
         deadline = time.monotonic() + self.retry_timeout
         attempt = 0
         self._last_retry_sleep = None  # fresh backoff ladder per batch
@@ -611,6 +697,11 @@ class ClusterClient(ParameterServerClient):
         unique, summed = aggregate_deltas(ids_arr, np.asarray(deltas), mask)
         if unique.size == 0:
             return 0
+        if self.hotcache is not None:
+            # write-through invalidate: the client's own cached copies
+            # are stale the moment this push applies (other sessions'
+            # copies are the shard lease board's job)
+            self.hotcache.invalidate(unique)
         self.pushes_coalesced += int(
             (ids_arr.size if mask is None else int(np.asarray(mask).sum()))
             - unique.size
@@ -711,6 +802,22 @@ class ClusterClient(ParameterServerClient):
         return n
 
     def close(self) -> None:
+        if (
+            self.hotcache is not None
+            and self._sess is not None
+            and self._lease_supported
+        ):
+            # best-effort lease release on live primary connections —
+            # the shard board stops tracking this session; failures
+            # are fine (the board evicts idle sessions on its own)
+            primaries = set(self._addresses)
+            for addr, conn in list(self._conns.items()):
+                if addr not in primaries:
+                    continue
+                try:
+                    conn.request(f"revoke all sess={self._sess}")
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
         for c in list(self._conns.values()):
             c.close()
         self._conns = {}
@@ -756,6 +863,10 @@ class ClusterClient(ParameterServerClient):
             suffix += f" pid={pid}"
         if self._epoch is not None:
             suffix += f" e={self._epoch}"
+        if self.hotcache is not None and self._sess is not None:
+            # declares a lease-capable session: responses may carry
+            # piggybacked inv= tokens (old servers parse-and-ignore)
+            suffix += f" sess={self._sess}"
         return suffix
 
     def _frame_trace(self, shard: int, name: str, ctx):
@@ -879,6 +990,142 @@ class ClusterClient(ParameterServerClient):
     def _pull_shard(
         self, shard: int, ids: np.ndarray, ctx=None
     ) -> np.ndarray:
+        """One shard's reads, hot/cold split.  Ids the lease policy
+        marks HOT (all of which already missed the cache) are read via
+        the ``lease`` verb — an atomic read + grant that fills the
+        cache — and the rest via plain ``pull``; both frame kinds go
+        out in ONE pipelined ``request_many`` on the primary, so the
+        hot tier never adds a wire round trip over the plain path.
+        Pure-cold batches keep the full hedged/replica-routed read
+        path.  A reject in either half replays the whole shard set —
+        pulls and leases are both idempotent reads."""
+        cache, policy = self.hotcache, self.lease_policy
+        if cache is None or policy is None or not self._lease_supported:
+            return self._pull_shard_wire(shard, ids, ctx)
+        hot = np.asarray(policy.is_hot(ids), bool)
+        if not hot.any():
+            return self._pull_shard_wire(shard, ids, ctx)
+        out = np.empty(
+            (len(ids),) + self.value_shape, np.float32
+        )
+        try:
+            try:
+                hot_rows, cold_rows = self._lease_pull_shard(
+                    shard, ids[hot], ids[~hot], ctx
+                )
+            except _LeaseUnsupported:
+                # pre-hotcache server: downgrade to plain pulls for the
+                # rest of this client's life (never re-probed)
+                self._lease_supported = False
+                return self._pull_shard_wire(shard, ids, ctx)
+        except _Rejected:
+            raise _Rejected(ids) from None
+        out[hot] = hot_rows
+        if cold_rows is not None:
+            out[~hot] = cold_rows
+        return out
+
+    def _lease_pull_shard(
+        self,
+        shard: int,
+        hot_ids: np.ndarray,
+        cold_ids: np.ndarray,
+        ctx=None,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """``lease`` frames for ``hot_ids`` + ``pull`` frames for
+        ``cold_ids``, pipelined in one request batch on the primary
+        (one round trip); leased rows are installed in the cache at
+        the current tick.  Returns ``(hot_rows, cold_rows-or-None)``;
+        rejects surface as :class:`_Rejected` exactly like pulls."""
+        prof = self._profiler
+        hot_chunks = [
+            hot_ids[i: i + self.chunk]
+            for i in range(0, len(hot_ids), self.chunk)
+        ]
+        cold_chunks = [
+            cold_ids[i: i + self.chunk]
+            for i in range(0, len(cold_ids), self.chunk)
+        ]
+        tok, span_cm, _span_id = self._frame_trace(shard, "lease", ctx)
+        suffix = self._frame_suffix() + tok
+        enc = " b64" if self.wire_format == "b64" else " text"
+        all_ids = np.concatenate([hot_ids, cold_ids])
+        hot_rows: List[np.ndarray] = []
+        cold_rows: List[np.ndarray] = []
+        rejected = False
+        with span_cm:
+            lines = [
+                "lease " + ",".join(str(int(i)) for i in c)
+                + enc + f" ttl={self._lease_ttl}" + suffix
+                for c in hot_chunks
+            ] + [
+                "pull " + ",".join(str(int(i)) for i in c)
+                + enc + suffix
+                for c in cold_chunks
+            ]
+            t0 = time.perf_counter()
+            resps = self._request_frames(
+                shard, all_ids, lines, hedgeable=False
+            )
+            per = (time.perf_counter() - t0) / max(1, len(lines))
+            for _ in lines:
+                if self._h_rtt is not None:
+                    self._h_rtt.observe(per)
+                prof.observe("pull", "rtt", per)
+            n_hot = len(hot_chunks)
+            for i, (resp, c) in enumerate(zip(
+                resps, hot_chunks + cold_chunks
+            )):
+                is_lease = i < n_hot
+                resp = self._apply_response_options(resp)
+                if _is_reject(resp) and self.membership is not None:
+                    rejected = True
+                    continue
+                if is_lease and resp.startswith("err bad-request"):
+                    raise _LeaseUnsupported(resp)
+                _check_ok(
+                    resp,
+                    f"{'lease' if is_lease else 'pull'} shard {shard}",
+                )
+                if is_lease:
+                    # ok n=<k> seq=<q> ttl=<r> <payload>
+                    parts = resp.split(" ", 4)
+                    if len(parts) < 5:
+                        raise RuntimeError(
+                            f"shard {shard} lease answer malformed: "
+                            f"{resp!r}"
+                        )
+                    body = parts[4]
+                else:
+                    # ok n=<k> <payload>
+                    _, _, body = resp.partition(" ")
+                    _, _, body = body.partition(" ")
+                with prof.timer("pull", "client_parse"):
+                    vals = parse_rows(body, self.value_shape)
+                if len(vals) != len(c):
+                    raise RuntimeError(
+                        f"shard {shard} answered {len(vals)} rows for "
+                        f"{len(c)} ids"
+                    )
+                if is_lease:
+                    self.hotcache.fill(c, vals)
+                    self.leases_acquired += len(c)
+                    hot_rows.append(vals)
+                else:
+                    cold_rows.append(vals)
+        if rejected:
+            raise _Rejected(all_ids)
+        hot_out = np.concatenate(hot_rows) if hot_rows else np.empty(
+            (0,) + self.value_shape, np.float32
+        )
+        cold_out = (
+            np.concatenate(cold_rows) if cold_rows else None
+        )
+        return hot_out, cold_out
+
+    def _pull_shard_wire(
+        self, shard: int, ids: np.ndarray, ctx=None
+    ) -> np.ndarray:
         chunks = [
             ids[i: i + self.chunk] for i in range(0, len(ids), self.chunk)
         ]
@@ -915,6 +1162,10 @@ class ClusterClient(ParameterServerClient):
                 prof.observe("pull", "rtt", per)
                 prof.observe("pull", "client_serialize", ser_per)
             for resp, c in zip(resps, chunks):
+                if self.hotcache is not None:
+                    # piggybacked inv= tokens ride any response to a
+                    # lease-capable session — strip and apply first
+                    resp = self._apply_response_options(resp)
                 if _is_reject(resp) and self.membership is not None:
                     rejected.append(c)
                     continue
@@ -977,6 +1228,8 @@ class ClusterClient(ParameterServerClient):
                 prof.observe("push", "client_serialize", ser_per)
         rejected: List[np.ndarray] = []
         for resp, c_ids in zip(resps, chunks):
+            if self.hotcache is not None:
+                resp = self._apply_response_options(resp)
             if _is_reject(resp) and self.membership is not None:
                 rejected.append(c_ids)
                 continue

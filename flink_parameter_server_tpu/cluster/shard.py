@@ -19,6 +19,10 @@ contract for observability options)::
 
     pull <id1,id2,...> [text|b64] [e=<n>] [t=<tok>]  # ids + answer format
     push <id1,id2,...> <payload> [pid=<t>] [e=<n>] [t=<tok>]  # deltas
+    lease <id1,id2,...> [text|b64] sess=<s> [ttl=<r>] [e=<n>]
+                                             # atomic read + lease grant
+                                             # (hotcache/, docs/hotcache.md)
+    revoke <id1,id2,...|all> sess=<s>        # client releases its leases
     xfer <id1,id2,...> [t=<tok>]             # atomic (rows, seq) snapshot
     load <id1,id2,...> <payload>             # row ASSIGNMENT (migration)
     repl <b64-frame> [head=<n>]              # ship one WAL record to a
@@ -31,6 +35,8 @@ contract for observability options)::
 
     ok n=<k> <payload>                    # pull answer
     ok applied=<k> seq=<n>                # push answer
+    ok n=<k> seq=<q> ttl=<r> <payload>    # lease answer (rows as-of seq)
+    ok revoked=<k>                        # revoke answer
     ok n=<k> seq=<s> <payload>            # xfer answer (always b64)
     ok loaded=<k> seq=<n>                 # load answer
     ok acked seg=<s> seq=<n>              # repl answer (the follower ack:
@@ -51,6 +57,19 @@ subset of what this shard already holds), and answered
 moving range is FROZEN: pushes touching it get ``err frozen`` (retry
 shortly — the flip is imminent); pulls and pushes of non-moving keys
 never block.
+
+Hot-key leases (hotcache/, docs/hotcache.md): a frame carrying
+``sess=<token>`` declares a lease-capable client session.  ``lease``
+is an atomic read + grant (the answered rows are exactly the state at
+the answered ``seq``); a later push by any OTHER session to a leased
+key queues an invalidation which **piggybacks** on the next response
+to the holder as a trailing ``inv=<id1,id2,...>`` token (``inv=*`` =
+drop everything — epoch flips and restarts).  Old servers parse and
+ignore the ``sess=`` option (the PR-6 trailing-token contract) and
+old clients never send it, so neither side ever sees a token it
+cannot handle.  The lease board is in-memory and best-effort by
+design: the CLIENT enforces the staleness bound locally, so a lost
+invalidation costs freshness inside the bound, never a violation.
 
 Exactly-once pushes: a frame carrying ``pid=<token>`` is deduplicated
 per ``(pid, id)`` against a bounded window that survives crashes (the
@@ -256,6 +275,13 @@ class ParamShard:
         # attached, every pulled/pushed id batch is observed — the
         # Zipf-skew measurement gating the serving hot-key tier
         self.hotkeys = hotkeys
+        # hot-key lease board (hotcache/leases.py): grants per client
+        # session + the piggybacked invalidation queues.  In-memory and
+        # best-effort — the client-side staleness bound is the safety
+        # net (docs/hotcache.md)
+        from ..hotcache.leases import LeaseBoard
+
+        self.leases = LeaseBoard(shard=self.shard_id, registry=registry)
         # latency-budget phases (telemetry/profiler.py): lock wait =
         # server_queue_wait (concurrent connections serialize on this
         # shard's lock), WAL append, scatter/apply — the server side of
@@ -519,6 +545,57 @@ class ParamShard:
                 self._c_pulls.inc()
             return vals
 
+    # -- hot-key leases (hotcache/, docs/hotcache.md) -------------------------
+    def lease_rows(
+        self,
+        global_ids: np.ndarray,
+        sess: str,
+        *,
+        epoch: Optional[int] = None,
+        ttl: Optional[int] = None,
+    ) -> Tuple[np.ndarray, int, int]:
+        """ATOMIC read + lease grant (the ``lease`` verb): the returned
+        ``(rows, seq, ttl)`` rows are exactly the state at push
+        sequence ``seq``, and from this moment any OTHER session's
+        write to these keys queues a piggybacked invalidation for
+        ``sess``.  One lock acquisition covers read + grant, so a write
+        can never slip between them unobserved.  ``ttl`` is advisory
+        (capped server-side); the client's staleness bound is the
+        enforced contract."""
+        if not sess:
+            raise ValueError("lease needs a sess=<token> option")
+        granted_ttl = min(int(ttl), 256) if ttl else 16
+        if granted_ttl < 1:
+            raise ValueError(f"ttl={ttl}: must be >= 1")
+        prof = self._profiler
+        t_wait = time.perf_counter()
+        with self._lock:
+            prof.observe(
+                "pull", "server_queue_wait",
+                time.perf_counter() - t_wait,
+            )
+            self._check_alive()
+            ids = np.asarray(global_ids, np.int64)
+            local = self._route(ids, epoch)
+            with prof.timer("pull", "scatter_apply"):
+                if self._host_mirror is None:
+                    self._host_mirror = np.asarray(self.store.values())
+                vals = self._host_mirror[local].copy()
+            self.pulls_served += 1
+            if self.hotkeys is not None:
+                self.hotkeys.observe(ids)
+            self.leases.grant(sess, ids)
+            if self._c_pulls is not None:
+                self._c_pulls.inc()
+            return vals, self._push_seq, granted_ttl
+
+    def revoke_leases(self, sess: str, global_ids=None) -> int:
+        """Client-requested release (the ``revoke`` verb); ``None`` ids
+        releases the whole session (client shutdown)."""
+        if not sess:
+            raise ValueError("revoke needs a sess=<token> option")
+        return self.leases.revoke(sess, global_ids)
+
     def push(
         self,
         global_ids: np.ndarray,
@@ -526,12 +603,17 @@ class ParamShard:
         *,
         epoch: Optional[int] = None,
         pid: Optional[str] = None,
+        sess: Optional[str] = None,
     ) -> int:
         """WRITE-AHEAD then apply; returns the shard's push sequence
         number after this push.  ``epoch`` fences against stale maps
         (old-epoch writes are rejected, never absorbed); ``pid`` makes
         the push idempotent per ``(pid, id)`` — the already-applied
-        subset of a retried frame is acked without re-applying."""
+        subset of a retried frame is acked without re-applying.
+        ``sess`` names the writer's lease session so its own leases are
+        not invalidation-queued (it invalidated locally at push time;
+        every OTHER holder of a written key gets a piggybacked
+        ``inv=``)."""
         prof = self._profiler
         t_wait = time.perf_counter()
         with self._lock:
@@ -575,6 +657,10 @@ class ParamShard:
             with prof.timer("push", "scatter_apply"):
                 self._apply(ids, deltas)
             self.rows_applied += int(len(ids))
+            # lease invalidation rides the write path: every other
+            # session holding a lease on a written key gets an inv=
+            # queued (board lock nests strictly under the shard lock)
+            self.leases.note_write(ids, writer=sess)
             if pid is not None:
                 self._remember_pairs(pid, ids)
             if self._c_pushes is not None:
@@ -649,6 +735,9 @@ class ParamShard:
             self._push_seq += 1
             self._assign(ids, values)
             self.loads_applied += int(len(ids))
+            # a migration load rewrites rows out-of-band of push: any
+            # lease on them is now serving a superseded value
+            self.leases.note_write(ids)
             return self._push_seq
 
     def freeze(self, global_ids) -> None:
@@ -710,6 +799,9 @@ class ParamShard:
             self._staged = {}
             self._frozen = None
             self.epoch = int(epoch)
+            # a resharding may re-home leased keys: queue drop-all for
+            # every session (clients also clear on membership refresh)
+            self.leases.drop_all()
             if self._wal is not None:
                 barrier = self._push_seq
                 payload = {
@@ -872,6 +964,10 @@ class ParamShard:
             self.pushes_applied = 0
             self._build()
             replayed = self._replay() if self._wal is not None else 0
+            # the board did not see writes replayed from the WAL —
+            # conservatively drop every remembered session's leases
+            # (holders fall back to their local staleness bound)
+            self.leases.drop_all()
             self.restarts += 1
             if self._c_restarts is not None:
                 self._c_restarts.inc()
@@ -902,6 +998,9 @@ class ParamShard:
                     0 if self._wal is None else self._wal.records_appended
                 ),
                 "dedupe_pairs": len(self._applied_pairs),
+                # hot-key lease board depth (hotcache/, psctl hot)
+                "lease_sessions": self.leases.sessions(),
+                "leases_active": self.leases.active_leases(),
             }
 
     def close(self) -> None:
@@ -1061,6 +1160,19 @@ class ShardServer(LineServer):
         with tr.span(f"shard.{cmd}", "cluster", **kwargs):
             return self._execute(line)
 
+    def _with_inv(self, resp: str, opts: dict) -> str:
+        """Piggyback pending lease invalidations for the frame's
+        session as a trailing ``inv=`` token (docs/hotcache.md).  Only
+        frames that declared ``sess=`` ever get one, so pre-hotcache
+        clients never see a token they cannot parse."""
+        sess = opts.get("sess")
+        if sess is None:
+            return resp
+        inv = self.shard.leases.take_invalidations(sess)
+        if inv:
+            resp += f" inv={inv}"
+        return resp
+
     def _execute(self, line: str) -> str:
         toks = line.split()
         cmd = toks[0].lower()
@@ -1082,7 +1194,7 @@ class ShardServer(LineServer):
             vals = self.shard.pull(ids, epoch=opts.get("e"))
             with self.profiler.timer("pull", "response_serialize"):
                 body = format_rows(vals, enc)
-            return f"ok n={len(ids)} {body}"
+            return self._with_inv(f"ok n={len(ids)} {body}", opts)
         if cmd == "push":
             if len(toks) < 3:
                 raise ValueError(
@@ -1099,8 +1211,52 @@ class ShardServer(LineServer):
             opts = self._parse_opts(toks[3:])
             seq = self.shard.push(
                 ids, deltas, epoch=opts.get("e"), pid=opts.get("pid"),
+                sess=opts.get("sess"),
             )
-            return f"ok applied={len(ids)} seq={seq}"
+            return self._with_inv(f"ok applied={len(ids)} seq={seq}", opts)
+        if cmd == "lease":
+            # atomic read + lease grant (hotcache/, docs/hotcache.md):
+            # answered rows are exactly the state at the answered seq
+            if len(toks) < 2:
+                raise ValueError(
+                    "usage: lease <id1,id2,...> [text|b64] sess=<token> "
+                    "[ttl=<rounds>] [e=<epoch>]"
+                )
+            rest = toks[2:]
+            enc = "text"
+            if rest and rest[0].lower() in ("text", "b64"):
+                enc = rest[0].lower()
+                rest = rest[1:]
+            elif rest and "=" not in rest[0]:
+                raise ValueError(
+                    f"lease format {rest[0]!r}: 'text' | 'b64'"
+                )
+            opts = self._parse_opts(rest)
+            ids = parse_ids(toks[1])
+            ttl = opts.get("ttl")
+            if ttl is not None:
+                try:
+                    ttl = int(ttl)
+                except ValueError:
+                    raise ValueError(
+                        f"ttl={ttl!r}: must be an integer"
+                    ) from None
+            vals, seq, ttl = self.shard.lease_rows(
+                ids, opts.get("sess"), epoch=opts.get("e"), ttl=ttl,
+            )
+            body = format_rows(vals, enc)
+            return self._with_inv(
+                f"ok n={len(ids)} seq={seq} ttl={ttl} {body}", opts
+            )
+        if cmd == "revoke":
+            if len(toks) < 2:
+                raise ValueError(
+                    "usage: revoke <id1,id2,...|all> sess=<token>"
+                )
+            opts = self._parse_opts(toks[2:])
+            ids = None if toks[1].lower() == "all" else parse_ids(toks[1])
+            n = self.shard.revoke_leases(opts.get("sess"), ids)
+            return f"ok revoked={n}"
         if cmd == "xfer":
             if len(toks) < 2:
                 raise ValueError("usage: xfer <id1,id2,...> [t=<token>]")
@@ -1155,8 +1311,8 @@ class ShardServer(LineServer):
             # (utils/net.py ConnStats) of THIS shard's front end
             return "ok " + json.dumps(self.conn_table())
         raise ValueError(
-            f"unknown command {cmd!r} "
-            f"(pull|push|xfer|load|repl|replstate|flush|stats|conns)"
+            f"unknown command {cmd!r} (pull|push|lease|revoke|xfer|load"
+            f"|repl|replstate|flush|stats|conns)"
         )
 
 

@@ -129,7 +129,7 @@ class ElasticClusterDriver(ClusterDriver):
                     self.registry if self.registry is not None else False
                 ),
             )
-        return ClusterClient(
+        client = ClusterClient(
             value_shape=self.value_shape,
             window=cfg.window,
             chunk=cfg.chunk,
@@ -143,6 +143,10 @@ class ElasticClusterDriver(ClusterDriver):
             retry_timeout=getattr(cfg, "retry_timeout", 30.0),
             tracer=self.client_tracer,
         )
+        # same hot-key lease cache wiring (and BSP carve-out) as the
+        # static driver — cluster/driver.py _attach_hot_cache
+        self._attach_hot_cache(client, worker)
+        return client
 
     def stop(self) -> None:
         with self._resize_lock:

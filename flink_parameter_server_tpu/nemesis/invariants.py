@@ -53,6 +53,7 @@ import numpy as np
 _OWNED_THREAD_PREFIXES = (
     "shard-", "nemesis-", "cluster-", "elastic-", "repl-", "serving",
     "chaos", "line-server", "wal-", "hb-", "ship-", "telemetry",
+    "hotcache-",
 )
 
 
@@ -139,6 +140,30 @@ def check_serving_budget(
     )
 
 
+def check_lease_staleness(
+    cache_stats: dict, bound: int
+) -> Verdict:
+    """The hot-key cache's staleness contract under fault
+    (docs/hotcache.md): every row the client-edge cache SERVED was at
+    most ``bound`` ticks old — through partitions, lost invalidations
+    and shard restarts, because the bound is enforced client-locally.
+    Vacuous passes are rejected: the cache must actually have served
+    (``hits > 0``), otherwise the scenario never exercised the tier it
+    claims to prove."""
+    hits = int(cache_stats.get("hits", 0))
+    worst = int(cache_stats.get("max_served_age", 0))
+    revoked = int(cache_stats.get("revocations", 0))
+    stale = int(cache_stats.get("stale_rejects", 0))
+    ok = hits > 0 and worst <= bound
+    return Verdict(
+        "lease_staleness", ok,
+        f"cache_hits={hits} worst_served_age={worst} bound={bound} "
+        f"revocations={revoked} stale_rejects={stale}"
+        + ("" if worst <= bound else " — BOUND VIOLATED")
+        + ("" if hits else " — cache never served (vacuous)"),
+    )
+
+
 def check_lock_inversions(inversions) -> Verdict:
     n = len(inversions)
     return Verdict(
@@ -219,6 +244,7 @@ __all__ = [
     "ThreadLedger",
     "Verdict",
     "check_exactly_once",
+    "check_lease_staleness",
     "check_lock_inversions",
     "check_no_errors",
     "check_parity",

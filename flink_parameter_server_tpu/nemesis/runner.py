@@ -53,12 +53,18 @@ from .invariants import (
     ThreadLedger,
     Verdict,
     check_exactly_once,
+    check_lease_staleness,
     check_lock_inversions,
     check_no_errors,
     check_parity,
     check_serving_budget,
     check_staleness,
 )
+
+# the cached reader's staleness bound, in ticks (1 tick = 1 reader
+# pull): what the lease_staleness verdict of a hotcache scenario is
+# checked against
+HOTCACHE_READER_BOUND = 3
 from .proxy import ChaosProxy, ProxiedServer
 from .scenarios import (
     BUILTIN_SCENARIOS,
@@ -363,6 +369,7 @@ def run_scenario(
     errors: List[str] = []
     served = [0]
     read_errors = [0]
+    reader_cache_stats: dict = {}
     progress = {"round": -1, "done": False}
     cond = threading.Condition()
     ops_executed = [0]
@@ -431,6 +438,20 @@ def run_scenario(
                 ids = np.arange(
                     min(8, scenario.num_items), dtype=np.int64
                 )
+                cache = None
+                if scenario.hotcache:
+                    # the cached serving reader (hotcache/): every read
+                    # id is leaseable, bound enforced client-side — the
+                    # lease_staleness verdict audits what it served
+                    from ..hotcache import HotRowCache, StaticHotSet
+
+                    cache = HotRowCache(
+                        HOTCACHE_READER_BOUND, capacity=64,
+                        registry=reg, worker="nemesis-reader",
+                    )
+                    client.attach_hotcache(
+                        cache, StaticHotSet(ids), lease_ttl=8
+                    )
                 try:
                     while not stop_reader.is_set():
                         try:
@@ -440,6 +461,8 @@ def run_scenario(
                             read_errors[0] += 1
                         stop_reader.wait(0.004)
                 finally:
+                    if cache is not None:
+                        reader_cache_stats.update(cache.stats())
                     client.close()
 
             op_thread = threading.Thread(
@@ -501,6 +524,10 @@ def run_scenario(
     if scenario.serving_reads:
         verdicts.append(check_serving_budget(
             served[0], read_errors[0], budget=serving_budget
+        ))
+    if scenario.hotcache:
+        verdicts.append(check_lease_staleness(
+            reader_cache_stats, bound=HOTCACHE_READER_BOUND
         ))
     if witness:
         verdicts.append(check_lock_inversions(inversions))

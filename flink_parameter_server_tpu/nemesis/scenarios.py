@@ -31,11 +31,13 @@ round-trips byte-identically through :meth:`Scenario.to_json` /
 (``nemesis/corpus/``) and what the shrinker's minimized output is
 committed as.
 
-``BUILTIN_SCENARIOS`` is the fixed-seed battery tier-1 replays — ten
-scenarios covering every proxy fault class, including the asymmetric
-partition splitting a live migration and kill-primary-under-partition
-— plus ``VIOLATION_SCENARIO``, the deliberately seeded corruption the
-checkers must catch.
+``BUILTIN_SCENARIOS`` is the fixed-seed battery tier-1 replays —
+eleven scenarios covering every proxy fault class, including the
+asymmetric partition splitting a live migration,
+kill-primary-under-partition, and the partition-client-mid-lease
+schedule proving the hot-key cache's staleness bound holds through a
+fault (hotcache/, docs/hotcache.md) — plus ``VIOLATION_SCENARIO``,
+the deliberately seeded corruption the checkers must catch.
 """
 from __future__ import annotations
 
@@ -116,6 +118,12 @@ class Scenario:
     replicated: bool = False
     parity: bool = True
     serving_reads: bool = True
+    # the reader thread serves through a client-edge hot-key lease
+    # cache (hotcache/, docs/hotcache.md) and the run must satisfy the
+    # lease_staleness invariant — no cached row served past the bound,
+    # through whatever the schedule does to the wire.  Workers stay
+    # BSP-uncached (the carve-out), so parity remains meaningful.
+    hotcache: bool = False
     request_timeout: float = 15.0
     retry_timeout: float = 60.0
     expect: str = "pass"
@@ -313,7 +321,25 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
         ),
         seed=109,
     ),
-    # 10. half-open accept: the dial succeeds, the server never answers
+    # 10. ISSUE-11 anchor: partition the CLIENT mid-lease — the reader
+    # holds hot-key leases (hotcache/) when shard 0's link blackholes
+    # both ways, then shard 1's response leg stalls.  Piggybacked
+    # invalidations cannot arrive through a partition, which is exactly
+    # the case the client-local staleness bound exists for: the
+    # lease_staleness checker proves no cached row was ever served
+    # past the bound, while cached hits keep the serving error budget
+    # clean through the fault window.
+    Scenario(
+        "partition_client_mid_lease",
+        (
+            NemesisOp(3, "partition", shard=0, mode="both", ms=250.0),
+            NemesisOp(6, "partition", shard=1, mode="s2c", ms=150.0),
+        ),
+        seed=111,
+        rounds=14,
+        hotcache=True,
+    ),
+    # 11. half-open accept: the dial succeeds, the server never answers
     # — the client's read deadline, not the connect, is what saves it.
     # The preceding mid-frame RST kills the pooled connection, so the
     # redial is what lands on the half-open accept (pooled connections

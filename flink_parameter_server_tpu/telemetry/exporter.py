@@ -206,6 +206,13 @@ class TelemetryServer(LineServer):
             ) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("hot"):
+            # the live hot-key TABLE (psctl hot): sketch top-K joined
+            # with the client-edge lease-cache state — which hot keys
+            # are currently leased somewhere, how old, how often hit
+            body = json.dumps({"hot": self._hot_table()}) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         elif path.startswith("budget"):
             # the latency-budget profiler's per-verb phase breakdown
             # (telemetry/profiler.py) — the `psctl budget` answer
@@ -230,7 +237,7 @@ class TelemetryServer(LineServer):
         else:
             body = (
                 f"unknown path {path!r} "
-                f"(metrics|healthz|hotkeys|budget|conns)\n"
+                f"(metrics|healthz|hotkeys|hot|budget|conns)\n"
             )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"
@@ -262,6 +269,53 @@ class TelemetryServer(LineServer):
         stats.bytes_out += len(sent)
         stats.frames_out += 1
         self.meter.count("out", verb, len(sent))
+
+    def _hot_table(self, n: int = 16) -> dict:
+        """The ``hot`` path's payload: the merged sketch top-K
+        (telemetry/hotkeys.py) joined per key with the registered
+        client-edge caches' lease state (hotcache/cache.py) — the one
+        view that answers "who is hot, and is the tier absorbing
+        them?" live."""
+        from ..hotcache.cache import cache_snapshots
+        from .hotkeys import get_aggregator
+
+        agg = get_aggregator()
+        snaps = cache_snapshots()
+        # key -> the freshest lease entry across every cache
+        by_key: dict = {}
+        for label, snap in snaps.items():
+            for entry in snap.get("keys", ()):
+                cur = by_key.get(entry["key"])
+                if cur is None or entry["age"] < cur["age"]:
+                    by_key[entry["key"]] = {
+                        "age": entry["age"],
+                        "hits": entry["hits"],
+                        "cache": label,
+                    }
+        top = []
+        for rank, item in enumerate(agg.top_k(n)):
+            row = {
+                "rank": rank,
+                "key": item["key"],
+                "count": item["count"],
+                "err": item["err"],
+                "leased": item["key"] in by_key,
+            }
+            row.update(by_key.get(item["key"], {}))
+            top.append(row)
+        return {
+            "top": top,
+            "total_observed": agg.total(),
+            "error_bound": agg.error_bound(),
+            "caches": {
+                label: {
+                    k: snap[k]
+                    for k in ("hits", "misses", "hit_rate", "entries",
+                              "revocations", "stale_rejects", "bound")
+                }
+                for label, snap in snaps.items()
+            },
+        }
 
     def _healthz(self) -> dict:
         out = {"status": "ok", "run_id": self.registry.run_id}

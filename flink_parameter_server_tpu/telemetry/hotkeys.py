@@ -125,6 +125,15 @@ class CountMinSketch:
         self.table += other.table
         self.total += other.total
 
+    def halve(self) -> None:
+        """Windowed decay: halve every counter (and the stream total).
+        Halving preserves the overestimation guarantee relative to the
+        halved stream — the exponential-decay trick that keeps the
+        estimates tracking CURRENT traffic instead of all-time
+        traffic."""
+        self.table >>= 1
+        self.total //= 2
+
 
 class SpaceSavingTopK:
     """Metwally space-saving: at most ``capacity`` tracked keys; every
@@ -225,6 +234,23 @@ class SpaceSavingTopK:
             }
         self._key_cache = None
 
+    def halve(self) -> None:
+        """Windowed decay (the fossilization fix): halve every tracked
+        count and error, dropping keys that decay to zero.  Without
+        this, a long-running stream's top-K freezes on early-epoch
+        keys — a key that was hot in hour 1 keeps a count no current
+        key can catch, so lease grants (hotcache/policy.py) would chase
+        stale celebrities forever.  Periodic halving turns the counts
+        into an exponentially-decayed window: a key must KEEP being hot
+        to stay on top."""
+        counts = {k: c >> 1 for k, c in self._counts.items() if c >> 1}
+        self._counts = counts
+        self._errs = {
+            k: self._errs.get(k, 0) >> 1 for k in counts
+        }
+        self.total //= 2
+        self._key_cache = None
+
     @property
     def min_tracked(self) -> int:
         """The smallest tracked count (0 while under capacity) — the
@@ -292,6 +318,7 @@ class HotKeySketch:
         depth: int = 3,
         seed: int = 0,
         buffer_ids: int = 16384,
+        decay_window: Optional[int] = None,
     ):
         self.cms = CountMinSketch(width, depth, seed)
         self.topk = SpaceSavingTopK(k)
@@ -299,6 +326,18 @@ class HotKeySketch:
         self._buffer_ids = max(1, int(buffer_ids))
         self._pending: List[np.ndarray] = []
         self._pending_n = 0
+        # windowed decay: every `decay_window` observed ids both
+        # sketches are halved, so top-K and estimates track CURRENT
+        # popularity (a mid-stream popularity shift overtakes the old
+        # regime within ~one window).  None = all-time counts, the
+        # pre-decay behaviour.
+        if decay_window is not None and decay_window < 1:
+            raise ValueError(
+                f"decay_window={decay_window}: must be >= 1 or None"
+            )
+        self.decay_window = decay_window
+        self._since_decay = 0
+        self.decays = 0
 
     def _flush_locked(self) -> None:
         if not self._pending:
@@ -312,6 +351,26 @@ class HotKeySketch:
         uniq, c = np.unique(ids, return_counts=True)
         self.cms.add(uniq, c)
         self.topk.update(uniq, c, assume_unique=True)
+        self._maybe_decay_locked(int(ids.size))
+
+    def _maybe_decay_locked(self, observed: int) -> None:
+        if self.decay_window is None:
+            return
+        self._since_decay += observed
+        while self._since_decay >= self.decay_window:
+            self._since_decay -= self.decay_window
+            self.cms.halve()
+            self.topk.halve()
+            self.decays += 1
+
+    def decay(self) -> None:
+        """Explicitly halve both sketches (flushing first) — the
+        manual form of ``decay_window``."""
+        with self._lock:
+            self._flush_locked()
+            self.cms.halve()
+            self.topk.halve()
+            self.decays += 1
 
     @property
     def total(self) -> int:
@@ -333,6 +392,7 @@ class HotKeySketch:
                 self._flush_locked()
                 self.cms.add(ids, counts)
                 self.topk.update(ids, counts)
+                self._maybe_decay_locked(int(counts.sum()))
             return
         with self._lock:
             self._pending.append(ids)
@@ -417,6 +477,18 @@ class HotKeyAggregator:
         for s in sketches:
             merged.merge(s)
         return merged
+
+    def candidates(self, n: int = 16) -> List[Dict[str, int]]:
+        """The merged top-``n`` WITHOUT the ops/topk final selection —
+        pure numpy/python, so a latency-sensitive caller (the hotcache
+        lease policy's refresh thread) never dispatches a jax op while
+        holding the GIL next to a serving hot path.  Same candidate
+        set and count bounds as :meth:`top_k`; only the final ranking
+        kernel differs (a python sort)."""
+        merged = self._merged()
+        if merged is None:
+            return []
+        return merged.top_k(n)
 
     def top_k(self, n: int = 16) -> List[Dict[str, int]]:
         merged = self._merged()

@@ -156,6 +156,9 @@ def build_run_report(
     hot = _hot_keys_section()
     if hot is not None:
         report["hot_keys"] = hot
+    hotcache = _hotcache_section()
+    if hotcache is not None:
+        report["hotcache"] = hotcache
     if extra:
         report["extra"] = dict(extra)
     return report
@@ -217,6 +220,35 @@ def _hot_keys_section(n: int = 10) -> Optional[Dict[str, Any]]:
     if not agg.labels():
         return None
     return agg.snapshot(n)
+
+
+def _hotcache_section() -> Optional[Dict[str, Any]]:
+    """Hot-key lease cache roll-up (hotcache/, docs/hotcache.md) —
+    per-cache hit/miss/revoke/staleness figures plus the aggregate hit
+    rate; None when no cache is registered."""
+    from ..hotcache.cache import cache_snapshots
+
+    snaps = cache_snapshots()
+    if not snaps:
+        return None
+    hits = sum(s["hits"] for s in snaps.values())
+    misses = sum(s["misses"] for s in snaps.values())
+    return {
+        "caches": {
+            label: {
+                k: s[k]
+                for k in ("hits", "misses", "hit_rate", "entries",
+                          "fills", "revocations", "stale_rejects",
+                          "evictions", "max_served_age", "bound")
+            }
+            for label, s in snaps.items()
+        },
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": (
+            round(hits / (hits + misses), 4) if hits + misses else None
+        ),
+    }
 
 
 def _default_platform() -> str:
@@ -342,6 +374,28 @@ def render_markdown(report: Dict[str, Any]) -> str:
         for item in hot["top"][:10]:
             lines.append(
                 f"| {item['key']} | {item['count']} | {item['err']} |"
+            )
+    hotcache = report.get("hotcache")
+    if hotcache:
+        lines += ["", "## Hot-key lease cache", ""]
+        lines.append(
+            f"aggregate: {hotcache['hits']} hits / "
+            f"{hotcache['misses']} misses "
+            f"(hit rate {fmt(hotcache['hit_rate'])})"
+        )
+        lines.append("")
+        lines += [
+            "| cache | hits | misses | hit rate | entries | revoked "
+            "| stale rejects | worst served age / bound |",
+            "|---|---|---|---|---|---|---|---|",
+        ]
+        for label in sorted(hotcache["caches"]):
+            c = hotcache["caches"][label]
+            lines.append(
+                f"| {label} | {c['hits']} | {c['misses']} | "
+                f"{fmt(c['hit_rate'])} | {c['entries']} | "
+                f"{c['revocations']} | {c['stale_rejects']} | "
+                f"{c['max_served_age']} / {c['bound']} |"
             )
     extra = report.get("extra")
     if extra:

@@ -244,8 +244,8 @@ class TestRealTree:
     def test_wire_verbs_fully_reconciled(self):
         """The live shard verb set is exactly what docs/cluster.md
         documents — the migration xfer/load family, the psctl conns
-        verb (the PR-8 drift fix), and the replica-chain repl/replstate
-        stream (PR 9)."""
+        verb (the PR-8 drift fix), the replica-chain repl/replstate
+        stream (PR 9), and the hot-key lease grant plane (PR 11)."""
         from tools.fpsanalyze.astindex import Index
         from tools.fpsanalyze.cli import _collect_files
         from tools.fpsanalyze.rules_drift import (
@@ -263,8 +263,8 @@ class TestRealTree:
             ROOT, "docs/cluster.md", "wire-verbs shard"
         )
         assert handled == {
-            "pull", "push", "xfer", "load", "repl", "replstate",
-            "flush", "stats", "conns",
+            "pull", "push", "lease", "revoke", "xfer", "load", "repl",
+            "replstate", "flush", "stats", "conns",
         }
         assert documented == handled
 

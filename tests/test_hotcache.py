@@ -1,0 +1,738 @@
+"""hotcache/ — staleness-bounded hot-key lease cache tests.
+
+The acceptance anchors (ISSUE 11):
+
+  * the consistency carve-out — BSP parity with the cache ENABLED
+    (bound-0 worker clients must bypass it; 1-worker runs bitwise
+    equal), the SSP bound enforced AT the cache (entries past the
+    bound fall through to the shard, never served), and
+    invalidate-on-push observed within one round of a conflicting
+    write;
+  * the wire protocol — ``lease`` is an atomic read + grant, ``inv=``
+    piggybacks only to declared sessions, old-server/old-client
+    compatibility both ways (trailing tokens parse-and-ignore; a new
+    client downgrades on ``err bad-request``);
+  * the satellites — SpaceSaving/CountMin windowed decay tracks a
+    mid-stream popularity shift, the ``lease_staleness`` checker
+    rejects both bound violations and vacuous passes, ``psctl hot``
+    renders the live table against a real 2-shard cluster, and the
+    run report grows a hotcache section.
+"""
+import io
+import json
+import threading
+import time
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+from flink_parameter_server_tpu.cluster import (
+    ClusterConfig,
+    ClusterDriver,
+    RangePartitioner,
+)
+from flink_parameter_server_tpu.cluster.client import ClusterClient
+from flink_parameter_server_tpu.cluster.shard import ParamShard, ShardServer
+from flink_parameter_server_tpu.hotcache import (
+    HotRowCache,
+    LeaseBoard,
+    LeasePolicy,
+    StaticHotSet,
+    cache_snapshots,
+    parse_inv_token,
+    register_cache,
+    split_response_options,
+    unregister_cache,
+)
+from flink_parameter_server_tpu.nemesis.invariants import (
+    check_lease_staleness,
+)
+from flink_parameter_server_tpu.telemetry.hotkeys import (
+    CountMinSketch,
+    HotKeySketch,
+    SpaceSavingTopK,
+)
+from flink_parameter_server_tpu.telemetry.registry import MetricsRegistry
+
+pytestmark = pytest.mark.hotcache
+
+
+# ---------------------------------------------------------------------------
+# workload helpers (the repo's standard seeded MF stream)
+# ---------------------------------------------------------------------------
+
+
+def _mf_workload(rounds=6, batch=96, num_users=48, num_items=64, dim=4):
+    from flink_parameter_server_tpu.data.movielens import synthetic_ratings
+    from flink_parameter_server_tpu.data.streams import microbatches
+    from flink_parameter_server_tpu.utils.initializers import (
+        ranged_random_factor,
+    )
+
+    cols = synthetic_ratings(num_users, num_items, rounds * batch, seed=3)
+    return list(microbatches(cols, batch)), ranged_random_factor(7, (dim,))
+
+
+def _mf_logic(num_users=48, dim=4):
+    from flink_parameter_server_tpu.models.matrix_factorization import (
+        OnlineMatrixFactorization,
+        SGDUpdater,
+    )
+
+    return OnlineMatrixFactorization(
+        num_users, dim, updater=SGDUpdater(0.05), seed=1
+    )
+
+
+def _mini_cluster(value_shape=(2,), capacity=32, shards=2):
+    part = RangePartitioner(capacity, shards)
+    shard_objs, servers = [], []
+    for s in range(shards):
+        sh = ParamShard(s, part, value_shape, registry=False)
+        sv = ShardServer(sh, port=0).start()
+        shard_objs.append(sh)
+        servers.append(sv)
+    addrs = [(sv.host, sv.port) for sv in servers]
+    return part, shard_objs, servers, addrs
+
+
+# ---------------------------------------------------------------------------
+# trailing-token idioms
+# ---------------------------------------------------------------------------
+
+
+class TestResponseOptions:
+    def test_strips_only_known_keys(self):
+        body, opts = split_response_options("ok n=2 b64:AAAA== inv=3,4")
+        assert body == "ok n=2 b64:AAAA=="  # b64 '=' padding untouched
+        assert opts == {"inv": "3,4"}
+
+    def test_ok_fields_never_consumed(self):
+        body, opts = split_response_options("ok applied=2 seq=5")
+        assert body == "ok applied=2 seq=5" and opts == {}
+
+    def test_text_payload_untouched(self):
+        body, opts = split_response_options("ok n=1 1.0,2.0;3.0,4.0")
+        assert body.endswith("1.0,2.0;3.0,4.0") and opts == {}
+
+    def test_drop_all_marker(self):
+        assert parse_inv_token("*") is None
+        assert parse_inv_token("3,5").tolist() == [3, 5]
+
+
+# ---------------------------------------------------------------------------
+# LeaseBoard (shard side)
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseBoard:
+    def test_grant_note_write_take(self):
+        b = LeaseBoard(registry=False)
+        b.grant("A", [1, 2, 3])
+        b.grant("B", [2])
+        # B writes key 2: A gets an inv queued, B (the writer) does not
+        assert b.note_write([2], writer="B") == 1
+        assert b.take_invalidations("A") == "2"
+        assert b.take_invalidations("A") is None  # drained
+        assert b.take_invalidations("B") is None
+        # A's grant on 2 was dropped with the queue entry
+        assert not b.holds("A", 2) and b.holds("A", 1)
+
+    def test_revoke_releases_without_inv(self):
+        b = LeaseBoard(registry=False)
+        b.grant("A", [1, 2])
+        assert b.revoke("A", [1]) == 1
+        assert b.revoke("A") == 1  # the rest
+        assert b.take_invalidations("A") is None
+
+    def test_drop_all_marks_every_session(self):
+        b = LeaseBoard(registry=False)
+        b.grant("A", [1])
+        b.grant("B", [2])
+        b.drop_all()
+        assert b.take_invalidations("A") == "*"
+        assert b.take_invalidations("B") == "*"
+        assert b.active_leases() == 0
+
+    def test_session_cap_evicts_lru(self):
+        b = LeaseBoard(registry=False, max_sessions=2)
+        b.grant("A", [1])
+        b.grant("B", [2])
+        b.grant("C", [3])  # evicts A (least recently contacted)
+        assert b.sessions() == 2
+        assert not b.holds("A", 1)
+        assert b.sessions_evicted == 1
+
+    def test_inv_batch_cap_spills_to_next_response(self):
+        b = LeaseBoard(registry=False, inv_batch=2)
+        b.grant("A", [1, 2, 3])
+        b.note_write([1, 2, 3])
+        first = b.take_invalidations("A")
+        assert first == "1,2"
+        assert b.take_invalidations("A") == "3"
+
+
+# ---------------------------------------------------------------------------
+# HotRowCache (client side)
+# ---------------------------------------------------------------------------
+
+
+class TestHotRowCache:
+    def test_bound_enforced_at_lookup(self):
+        c = HotRowCache(2, registry=False, jitter_frac=0.0)
+        c.fill([7], np.array([[1.0, 1.0]]))
+        c.tick()
+        c.tick()
+        assert 7 in c.lookup([7])  # age 2 == bound: servable
+        c.tick()
+        assert 7 not in c.lookup([7])  # age 3 > bound: falls through
+        st = c.stats()
+        assert st["stale_rejects"] == 1
+        assert st["max_served_age"] <= 2
+
+    def test_bsp_bound_zero_rejected(self):
+        with pytest.raises(ValueError, match="bound=0"):
+            HotRowCache(0, registry=False)
+
+    def test_invalidate_and_drop_all(self):
+        c = HotRowCache(8, registry=False)
+        c.fill([1, 2, 3], np.ones((3, 2), np.float32))
+        assert c.invalidate([2]) == 1
+        assert 2 not in c.lookup([2])
+        assert c.invalidate(None) == 2  # inv=* drop-everything
+        assert len(c) == 0
+        assert c.stats()["revocations"] == 3
+
+    def test_capacity_evicts_oldest_fill(self):
+        c = HotRowCache(8, capacity=2, registry=False)
+        c.fill([1], np.ones((1, 2), np.float32))
+        c.tick()
+        c.fill([2], np.ones((1, 2), np.float32))
+        c.tick()
+        c.fill([3], np.ones((1, 2), np.float32))  # evicts 1
+        assert 1 not in c.lookup([1]) and 3 in c.lookup([3])
+        assert c.stats()["evictions"] == 1
+
+    def test_ttl_jitter_only_shortens(self):
+        c = HotRowCache(16, registry=False, jitter_frac=0.5)
+        ids = np.arange(32, dtype=np.int64)
+        c.fill(ids, np.ones((32, 2), np.float32))
+        bounds = {e.bound for e in c._entries.values()}
+        assert all(8 <= b <= 16 for b in bounds)
+        assert len(bounds) > 1  # actually spread, not constant
+
+    def test_registry_exposes_snapshots(self):
+        c = HotRowCache(4, registry=False)
+        register_cache("t-snap", c)
+        try:
+            c.fill([5], np.ones((1, 2), np.float32))
+            c.lookup([5])
+            snaps = cache_snapshots()
+            assert "t-snap" in snaps
+            assert snaps["t-snap"]["keys"][0]["key"] == 5
+        finally:
+            unregister_cache("t-snap")
+
+
+# ---------------------------------------------------------------------------
+# the wire protocol (in-process dispatch, no sockets needed)
+# ---------------------------------------------------------------------------
+
+
+def _bare_server(shard):
+    from flink_parameter_server_tpu.telemetry.profiler import (
+        resolve_profiler,
+    )
+
+    srv = ShardServer.__new__(ShardServer)
+    srv.shard = shard
+    srv.profiler = resolve_profiler(None)
+    srv.tracer = None
+    return srv
+
+
+class TestWireProtocol:
+    def test_lease_is_atomic_read_plus_grant(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        srv._execute("push 1,2 1.0,2.0;3.0,4.0")
+        resp = srv._execute("lease 1,2 b64 sess=A ttl=8")
+        assert resp.startswith("ok n=2 seq=1 ttl=8 b64:")
+        assert shard.leases.holds("A", 1) and shard.leases.holds("A", 2)
+        # leased rows == pulled rows, bitwise
+        from flink_parameter_server_tpu.cluster.shard import parse_rows
+
+        leased = parse_rows(resp.split(" ", 4)[4], (2,))
+        pulled = parse_rows(
+            srv._execute("pull 1,2 b64").split(" ", 2)[2], (2,)
+        )
+        assert np.array_equal(leased, pulled)
+
+    def test_inv_piggybacks_only_to_declared_sessions(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        srv._execute("push 1 1.0,1.0")
+        srv._execute("lease 1 b64 sess=A")
+        # writer B pushes the leased key
+        srv._execute("push 1 2.0,2.0 sess=B")
+        # a session-less pull never sees inv tokens
+        assert "inv=" not in srv._execute("pull 1 b64")
+        # A's next contact carries it, exactly once
+        r = srv._execute("pull 1 b64 sess=A")
+        assert r.endswith("inv=1")
+        assert "inv=" not in srv._execute("pull 1 b64 sess=A")
+
+    def test_writer_session_not_self_invalidated(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        srv._execute("lease 1 b64 sess=A")
+        srv._execute("push 1 1.0,1.0 sess=A")  # own write
+        assert "inv=" not in srv._execute("pull 1 b64 sess=A")
+
+    def test_revoke_and_unknown_tokens_ignored(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        srv._execute("lease 1,2 b64 sess=A")
+        assert srv._execute("revoke 1 sess=A") == "ok revoked=1"
+        assert srv._execute("revoke all sess=A") == "ok revoked=1"
+        # the PR-6 versioning contract: unknown trailing key=value
+        # tokens parse-and-ignore (an old server facing a new client)
+        assert srv._execute("push 3 1.0,1.0 zz=42").startswith("ok")
+
+    def test_lease_requires_session(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        assert srv._respond_supervised("lease 1 b64").startswith(
+            "err bad-request"
+        )
+
+    def test_epoch_flip_queues_drop_all(self):
+        part = RangePartitioner(16, 1)
+        shard = ParamShard(0, part, (2,), registry=False)
+        srv = _bare_server(shard)
+        srv._execute("lease 1 b64 sess=A")
+        shard.install_epoch(1, RangePartitioner(16, 1))
+        r = srv._execute("pull 1 b64 sess=A")
+        assert r.endswith("inv=*")
+
+
+# ---------------------------------------------------------------------------
+# client integration over real TCP
+# ---------------------------------------------------------------------------
+
+
+class TestClientIntegration:
+    def test_lease_hit_invalidate_cycle(self):
+        part, shards, servers, addrs = _mini_cluster()
+        cache = HotRowCache(4, registry=False)
+        a = ClusterClient(
+            addrs, part, (2,), registry=False,
+            hotcache=cache, lease_policy=StaticHotSet([0, 1, 17]),
+        )
+        b = ClusterClient(addrs, part, (2,), registry=False)
+        try:
+            ids = np.array([0, 1, 5, 17])
+            v1 = a.pull_batch(ids)  # misses; hot ids leased
+            assert a.leases_acquired == 3
+            a.pull_batch(ids)
+            assert cache.stats()["hits"] == 3  # hot ids served locally
+            # invalidate-on-push lands within ONE round: B pushes a
+            # leased key; A's next round (which still touches the
+            # shard for cold id 5) carries the inv and drops it, and
+            # the round after serves the fresh value
+            b.push_batch(np.array([1]), np.array([[9.0, 9.0]]))
+            a.pull_batch(ids)
+            assert cache.stats()["revocations"] >= 1
+            v3 = a.pull_batch(ids)
+            assert np.allclose(v3[1], v1[1] + [9.0, 9.0])
+        finally:
+            a.close()
+            b.close()
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+    def test_close_revokes_session(self):
+        part, shards, servers, addrs = _mini_cluster()
+        cache = HotRowCache(4, registry=False)
+        c = ClusterClient(
+            addrs, part, (2,), registry=False,
+            hotcache=cache, lease_policy=StaticHotSet([0, 17]),
+        )
+        try:
+            c.pull_batch(np.array([0, 17]))
+            assert sum(sh.leases.active_leases() for sh in shards) == 2
+            c.close()
+            assert sum(sh.leases.active_leases() for sh in shards) == 0
+        finally:
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+    def test_own_push_invalidates_locally(self):
+        part, shards, servers, addrs = _mini_cluster()
+        cache = HotRowCache(8, registry=False)
+        c = ClusterClient(
+            addrs, part, (2,), registry=False,
+            hotcache=cache, lease_policy=StaticHotSet([3]),
+        )
+        try:
+            c.pull_batch(np.array([3]))
+            assert len(cache) == 1
+            c.push_batch(np.array([3]), np.array([[1.0, 1.0]]))
+            assert len(cache) == 0  # write-through invalidate
+            v = c.pull_batch(np.array([3]))
+            assert np.allclose(v[0], [1.0, 1.0])
+        finally:
+            c.close()
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+
+# ---------------------------------------------------------------------------
+# the consistency carve-out (ISSUE acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestConsistencyCarveOut:
+    def test_bsp_bypasses_cache_bitwise_parity(self):
+        """BSP + hot_cache=True: the driver must NOT attach caches
+        (bound-0 reads must see every previous-round write) and a
+        1-worker run — deterministic push order — lands bitwise equal
+        to the cache-off run."""
+        batches, init = _mf_workload()
+
+        def run(hot_cache):
+            d = ClusterDriver(
+                _mf_logic(), capacity=64, value_shape=(4,), init_fn=init,
+                config=ClusterConfig(
+                    num_shards=2, num_workers=1, partition="hash",
+                    staleness_bound=0, hot_cache=hot_cache,
+                ),
+                registry=False,
+            )
+            with d:
+                values = d.run(batches).values
+                caches = [c.hotcache for c in d._clients]
+            return values, caches
+
+        v_off, _ = run(False)
+        v_on, caches = run(True)
+        assert all(c is None for c in caches), "BSP client got a cache"
+        assert np.array_equal(v_off, v_on)
+
+    def test_ssp_workers_get_cache(self):
+        batches, init = _mf_workload()
+        d = ClusterDriver(
+            _mf_logic(), capacity=64, value_shape=(4,), init_fn=init,
+            config=ClusterConfig(
+                num_shards=2, num_workers=2, partition="hash",
+                staleness_bound=2, hot_cache=True,
+            ),
+            registry=False,
+        )
+        with d:
+            assert all(c.hotcache is not None for c in d._clients)
+            assert all(
+                c.hotcache.bound == 2 for c in d._clients
+            )  # bound defaults to the SSP bound
+            result = d.run(batches)
+            # the final dump is the table of record: it must be shard
+            # truth, never a cached row (final_values clears first)
+            truth = np.concatenate(
+                [sh.values() for sh in d.shards]
+            )[np.argsort(np.concatenate([sh.owned for sh in d.shards]))]
+            assert np.array_equal(result.values, truth)
+
+    def test_ssp_bound_enforced_at_cache(self):
+        """A cached entry is never served past the bound: reads past
+        it fall through to the shard and observe the shard's CURRENT
+        row even when no invalidation ever arrived (the
+        lost-invalidation safety net)."""
+        part, shards, servers, addrs = _mini_cluster(shards=1)
+        cache = HotRowCache(2, registry=False, jitter_frac=0.0)
+        reader = ClusterClient(
+            addrs, part, (2,), registry=False,
+            hotcache=cache, lease_policy=StaticHotSet([4]),
+        )
+        try:
+            reader.pull_batch(np.array([4]))  # lease at tick 1
+            # out-of-band write, simulating an invalidation the reader
+            # never receives (it will not contact the shard again
+            # until the bound expires)
+            shards[0].push(np.array([4]), np.array([[5.0, 5.0]]))
+            vals = [
+                reader.pull_batch(np.array([4]))[0] for _ in range(4)
+            ]
+            # within the bound: the stale copy may legally be served
+            assert np.allclose(vals[0], 0.0)
+            # past the bound: fell through, fresh row observed
+            assert np.allclose(vals[-1], [5.0, 5.0])
+            assert cache.stats()["max_served_age"] <= 2
+            assert cache.stats()["stale_rejects"] >= 1
+        finally:
+            reader.close()
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+    def test_old_server_downgrade(self):
+        """Protocol versioning: against a server whose dispatch has no
+        lease verb, the client downgrades to plain pulls permanently
+        after one err bad-request — reads keep working, nothing
+        cached."""
+        part, shards, servers, addrs = _mini_cluster(shards=1)
+        orig = ShardServer._execute
+
+        def no_lease(self, line):
+            if line.split()[0].lower() in ("lease", "revoke"):
+                return "err bad-request: unknown command"
+            return orig(self, line)
+
+        ShardServer._execute = no_lease
+        try:
+            cache = HotRowCache(4, registry=False)
+            c = ClusterClient(
+                addrs, part, (2,), registry=False,
+                hotcache=cache, lease_policy=StaticHotSet([1]),
+            )
+            v = c.pull_batch(np.array([1, 2]))
+            assert v.shape == (2, 2)
+            assert not c._lease_supported
+            assert len(cache) == 0
+            c.pull_batch(np.array([1, 2]))  # stays on the plain path
+            c.close()
+        finally:
+            ShardServer._execute = orig
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+
+# ---------------------------------------------------------------------------
+# sketch decay (the fossilized-top-K fix)
+# ---------------------------------------------------------------------------
+
+
+class TestSketchDecay:
+    def test_popularity_shift_tracked_with_decay(self):
+        """Without decay a long stream's top-K fossilizes on
+        early-epoch keys; with windowed halving the NEW regime
+        overtakes within ~a window — the property lease grants need to
+        track current skew."""
+        rng = np.random.default_rng(0)
+        old_keys = np.arange(10)
+        new_keys = np.arange(100, 110)
+
+        # capacity comfortably above the hot sets: space-saving's
+        # at-capacity count inheritance never kicks in, so without
+        # decay an early-epoch key's all-time count is unbeatable —
+        # the exact long-running-run shape the ISSUE names
+        def shifted_stream(sketch):
+            for _ in range(100):  # phase A: old keys hot, long
+                sketch.observe(rng.choice(old_keys, 256))
+            for _ in range(30):  # phase B: popularity shifts
+                sketch.observe(rng.choice(new_keys, 256))
+
+        fossil = HotKeySketch(64, buffer_ids=1)
+        shifted_stream(fossil)
+        fossil_top = {d["key"] for d in fossil.top_k(10)}
+        assert fossil_top == set(old_keys)  # fossilized
+
+        fresh = HotKeySketch(64, buffer_ids=1, decay_window=4_000)
+        shifted_stream(fresh)
+        fresh_top = {d["key"] for d in fresh.top_k(10)}
+        assert fresh_top == set(new_keys)  # tracks the shift
+        assert fresh.decays > 0
+
+    def test_halve_preserves_ordering_and_drops_zeros(self):
+        ss = SpaceSavingTopK(8)
+        ss.update([1] * 10 + [2] * 4 + [3])
+        ss.halve()
+        counts = dict((k, c) for k, c, _ in ss.items())
+        assert counts[1] == 5 and counts[2] == 2
+        assert 3 not in counts  # 1 >> 1 == 0: dropped
+        cms = CountMinSketch(width=64, depth=2)
+        cms.add([1] * 10)
+        cms.halve()
+        assert cms.estimate([1])[0] == 5
+        assert cms.total == 5
+
+    def test_policy_follows_decayed_sketch(self):
+        sketch = HotKeySketch(16, buffer_ids=1, decay_window=2_000)
+        rng = np.random.default_rng(1)
+        policy = LeasePolicy(
+            sketch, top_n=10, min_count=4, async_refresh=False,
+        )
+        for _ in range(20):
+            sketch.observe(rng.choice(np.arange(10), 256))
+        assert set(policy.refresh().tolist()) == set(range(10))
+        for _ in range(20):
+            sketch.observe(rng.choice(np.arange(50, 60), 256))
+        hot = set(policy.refresh().tolist())
+        assert hot & set(range(50, 60))
+        assert policy.is_hot(np.array([55]))[0]
+
+
+# ---------------------------------------------------------------------------
+# invariant checker
+# ---------------------------------------------------------------------------
+
+
+class TestLeaseStalenessChecker:
+    def test_verdicts(self):
+        ok = check_lease_staleness(
+            {"hits": 10, "max_served_age": 3, "revocations": 2,
+             "stale_rejects": 1},
+            bound=3,
+        )
+        assert ok.ok
+        violated = check_lease_staleness(
+            {"hits": 10, "max_served_age": 4}, bound=3
+        )
+        assert not violated.ok and "BOUND VIOLATED" in violated.detail
+        vacuous = check_lease_staleness(
+            {"hits": 0, "max_served_age": 0}, bound=3
+        )
+        assert not vacuous.ok and "vacuous" in vacuous.detail
+
+
+# ---------------------------------------------------------------------------
+# serving tier + observability surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestCachedServing:
+    def test_cached_lookup_and_topk_fanout(self):
+        part, shards, servers, addrs = _mini_cluster(
+            value_shape=(4,), capacity=32
+        )
+        from flink_parameter_server_tpu.hotcache import (
+            CachedLookupService,
+        )
+
+        svc = CachedLookupService(
+            addresses=addrs, partitioner=part, value_shape=(4,),
+            policy=StaticHotSet(np.arange(8)),
+            bound=8, hedge_after_s=None, registry=False,
+        )
+        try:
+            rng = np.random.default_rng(0)
+            rows = rng.normal(size=(32, 4)).astype(np.float32)
+            for s in shards:
+                s.push(
+                    s.owned, rows[s.owned],
+                )
+            r1 = svc.lookup(np.arange(8))
+            assert r1.cache_misses == 8 and r1.cache_hits == 0
+            r2 = svc.lookup(np.arange(8))
+            assert r2.cache_hits == 8 and r2.cache_misses == 0
+            assert np.allclose(r2.values, rows[:8])
+            # cross-shard fan-out top-K == the numpy oracle
+            q = rng.normal(size=4).astype(np.float32)
+            cand = np.arange(32, dtype=np.int64)
+            scores, ids = svc.top_k(q, cand, k=5)
+            oracle = np.argsort(-(rows @ q))[:5]
+            assert set(ids.tolist()) == set(oracle.tolist())
+            assert np.allclose(
+                np.sort(scores)[::-1], np.sort(rows @ q)[::-1][:5],
+                rtol=1e-5,
+            )
+        finally:
+            svc.close()
+            for sv in servers:
+                sv.stop()
+            for sh in shards:
+                sh.close()
+
+    def test_run_report_section(self):
+        from flink_parameter_server_tpu.telemetry.report import (
+            build_run_report,
+            render_markdown,
+        )
+
+        cache = HotRowCache(4, registry=False)
+        cache.fill([1], np.ones((1, 2), np.float32))
+        cache.lookup([1, 2])
+        register_cache("t-report", cache)
+        try:
+            report = build_run_report(MetricsRegistry())
+            assert report["hotcache"]["hits"] == 1
+            assert report["hotcache"]["misses"] == 1
+            md = render_markdown(report)
+            assert "Hot-key lease cache" in md and "t-report" in md
+        finally:
+            unregister_cache("t-report")
+
+
+class TestPsctlHot:
+    def test_live_table_against_2_shard_cluster(self):
+        """`psctl hot` end to end: live 2-shard cluster with sketches
+        on, a registered client-edge cache, the TelemetryServer's hot
+        path, and the CLI rendering — one smoke covering the whole
+        satellite."""
+        from flink_parameter_server_tpu.telemetry.exporter import (
+            TelemetryServer,
+        )
+        from tools import psctl
+
+        reg = MetricsRegistry()
+        batches, init = _mf_workload(rounds=4)
+        d = ClusterDriver(
+            _mf_logic(), capacity=64, value_shape=(4,), init_fn=init,
+            config=ClusterConfig(
+                num_shards=2, num_workers=1, partition="hash",
+                staleness_bound=None, hot_keys=True,
+            ),
+            registry=reg,
+        )
+        tel = None
+        cache = HotRowCache(8, registry=False)
+        try:
+            with d:
+                d.run(batches)  # populate the sketches
+                client = d._make_client(worker="psctl-hot")
+                client.attach_hotcache(
+                    cache, StaticHotSet(np.arange(16))
+                )
+                client.pull_batch(np.arange(16, dtype=np.int64))
+                client.pull_batch(np.arange(16, dtype=np.int64))
+                register_cache("psctl-hot", cache)
+                tel = TelemetryServer(reg, port=0).start()
+                # the raw endpoint payload
+                doc = json.loads(
+                    psctl.scrape(tel.host, tel.port, "hot")
+                )["hot"]
+                assert doc["top"], "sketches saw traffic"
+                assert doc["caches"]["psctl-hot"]["hits"] == 16
+                leased = [t for t in doc["top"] if t.get("leased")]
+                assert leased, "top keys show lease state"
+                # the CLI rendering
+                buf = io.StringIO()
+                with redirect_stdout(buf):
+                    rc = psctl.main([
+                        "hot", "--metrics",
+                        f"{tel.host}:{tel.port}",
+                        "--iterations", "1", "--raw",
+                    ])
+                out = buf.getvalue()
+                assert rc == 0
+                assert "psctl hot" in out and "cache[psctl-hot]" in out
+                assert "rank" in out
+                client.close()
+        finally:
+            unregister_cache("psctl-hot")
+            if tel is not None:
+                tel.stop()

@@ -21,6 +21,7 @@ TOP_LEVEL = [
     "SnapshotManager",
     "MetricsRegistry", "SpanTracer", "TelemetryServer", "get_registry",
     "get_tracer", "prometheus_text", "build_run_report", "write_run_report",
+    "HotRowCache", "LeasePolicy", "CachedLookupService",
 ]
 
 MODULE_SYMBOLS = {
@@ -73,6 +74,13 @@ MODULE_SYMBOLS = {
         "NemesisElasticDriver", "NemesisReplicatedDriver",
         "run_scenario", "search_scenarios", "shrink", "load_corpus",
         "replay_corpus"],
+    "flink_parameter_server_tpu.hotcache": [
+        "HotRowCache", "LeaseBoard", "LeasePolicy", "StaticHotSet",
+        "CachedLookupService", "CachedLookupResult",
+        "register_cache", "unregister_cache", "cache_snapshots",
+        "split_response_options", "parse_inv_token"],
+    "flink_parameter_server_tpu.nemesis.invariants": [
+        "check_lease_staleness"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",

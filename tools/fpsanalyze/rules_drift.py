@@ -88,6 +88,7 @@ def default_drift_config(root: str) -> DriftConfig:
                     f"{pkg}/replication/chain.py",
                     f"{pkg}/nemesis/runner.py",
                     f"{pkg}/nemesis/scenarios.py",
+                    f"{pkg}/hotcache/serving.py",
                     "tools/psctl.py",
                 ],
                 ("docs/cluster.md", "wire-verbs shard"),

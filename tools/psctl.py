@@ -15,6 +15,8 @@ Usage::
     psctl stats  --shards HOST:PORT[,HOST:PORT...]
     psctl conns  --shards HOST:PORT[,...] | --metrics HOST:PORT
     psctl budget --metrics HOST:PORT [--verb pull] [--json]
+    psctl hot    --metrics HOST:PORT [--interval 2] [--iterations 0]
+                 [-n 16] [--json]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -22,6 +24,15 @@ pulls/sec, wire bytes/sec each way) and shows the live gauges
 (staleness, queue depths, in-flight pulls) plus the hottest latency-
 budget phase.  ``--iterations N`` stops after N frames (0 = forever);
 ``--raw`` skips the screen-clear escape (pipe/CI friendly).
+
+``hot`` is the live hot-key table (the ``hot`` path on the telemetry
+endpoint): the merged sketch top-K — who is actually being hammered —
+joined per key with the client-edge lease-cache state (leased where,
+entry age, per-key hits) plus each registered cache's hit rate, so an
+operator can see at a glance whether the hotcache tier is absorbing a
+storm or the celebrities are slipping through
+(docs/hotcache.md).  Same ``--interval``/``--iterations``/``--raw``
+loop as ``top``; ``--json`` emits the raw payload once.
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -296,6 +307,69 @@ def cmd_conns(args) -> int:
     return 0
 
 
+def cmd_hot(args) -> int:
+    host, port = parse_addr(args.metrics)
+    shown = 0
+    while True:
+        try:
+            doc = json.loads(scrape(host, port, "hot"))
+        except (OSError, ValueError) as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        h = doc.get("hot", {})
+        if args.json:
+            print(json.dumps(h, indent=2))
+            return 0
+        lines = [
+            f"psctl hot — {host}:{port} — "
+            f"{h.get('total_observed', 0)} ids observed "
+            f"(count-min error bound ±{h.get('error_bound', 0)})",
+        ]
+        rows = [
+            [
+                str(t.get("rank", "?")), str(t.get("key", "?")),
+                str(t.get("count", 0)),
+                "yes" if t.get("leased") else "—",
+                str(t["age"]) if t.get("leased") else "—",
+                str(t.get("hits", "—")) if t.get("leased") else "—",
+                t.get("cache", "—") if t.get("leased") else "—",
+            ]
+            for t in h.get("top", [])[: args.n]
+        ]
+        if rows:
+            lines.append("")
+            lines.append(_render_table(
+                ["rank", "key", "count", "leased", "age", "hits",
+                 "cache"],
+                rows,
+            ))
+        else:
+            lines.append("(no hot-key traffic observed yet)")
+        caches = h.get("caches", {})
+        if caches:
+            lines.append("")
+            for label in sorted(caches):
+                c = caches[label]
+                rate = c.get("hit_rate")
+                lines.append(
+                    f"cache[{label}]  hits {c.get('hits', 0)}  "
+                    f"misses {c.get('misses', 0)}  "
+                    f"hit rate {rate if rate is not None else '—'}  "
+                    f"entries {c.get('entries', 0)}  "
+                    f"revoked {c.get('revocations', 0)}  "
+                    f"stale rejects {c.get('stale_rejects', 0)}"
+                )
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_budget(args) -> int:
     host, port = parse_addr(args.metrics)
     try:
@@ -361,6 +435,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     cn.add_argument("--shards", metavar="HOST:PORT[,...]")
     cn.add_argument("--metrics", metavar="HOST:PORT")
     cn.set_defaults(fn=cmd_conns)
+
+    hot = sub.add_parser(
+        "hot", help="live hot-key table (sketch top-K × lease state)"
+    )
+    hot.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    hot.add_argument("--interval", type=float, default=2.0)
+    hot.add_argument("--iterations", type=int, default=0,
+                     help="stop after N frames (0 = forever)")
+    hot.add_argument("-n", type=int, default=16,
+                     help="rows to show (default 16)")
+    hot.add_argument("--raw", action="store_true",
+                     help="no screen clear (pipe/CI friendly)")
+    hot.add_argument("--json", action="store_true",
+                     help="emit the raw payload once")
+    hot.set_defaults(fn=cmd_hot)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
