@@ -1063,6 +1063,62 @@ def _emit_hotcache_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_soak_metric(platform: str, fallback: bool) -> None:
+    """Tenth (opt-in) metric line: the open-loop soak + overload A/B.
+
+    FPS_BENCH_SOAK=1 runs benchmarks/soak_capacity.py — a capacity
+    sweep (QPS vs shards×replicas at the p99 SLO), a 2×-capacity
+    open-loop A/B (overload-control plane on vs off, nemesis schedule
+    underneath) and an autoscaler-quality trace — and writes
+    ``results/<platform>/soak_capacity.{md,json}``, the artifact any
+    production-traffic claim must cite (docs/loadgen.md).
+    FPS_BENCH_SOAK_SECONDS shortens the A/B arms (default 60).
+    Default 0 (the A/B costs minutes); failure degrades to a
+    value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_SOAK", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_SOAK={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "soak goodput at 2x capacity (open-loop, overload control on)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.soak_capacity import run_soak_bench
+
+        r = run_soak_bench(
+            duration_s=float(os.environ.get("FPS_BENCH_SOAK_SECONDS", "60"))
+        )
+        on, off = r["arms"]["on"], r["arms"]["off"]
+        print(json.dumps({
+            "metric": metric,
+            "value": on["goodput_rps"],
+            "unit": "req/sec",
+            "extra": {
+                "capacity_rps": r["capacity_rps"],
+                "offered_rps": r["offered_rps"],
+                "goodput_frac_of_capacity_on":
+                    r["goodput_frac_of_capacity_on"],
+                "goodput_frac_of_capacity_off":
+                    r["goodput_frac_of_capacity_off"],
+                "p99_ms_on": on["p99_ms"],
+                "p99_ms_off": off["p99_ms"],
+                "shed_on": on["shed"],
+                "shed_off": off["shed"],
+                "autoscaler_score": r["autoscaler"]["score"],
+                "invariants_ok": r["invariants_ok"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "req/sec",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1093,6 +1149,7 @@ def main():
             _emit_failover_metric(platform, fallback)
             _emit_nemesis_metric(platform, fallback)
             _emit_hotcache_metric(platform, fallback)
+            _emit_soak_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1150,6 +1207,7 @@ def main():
     _emit_failover_metric(platform, fallback)
     _emit_nemesis_metric(platform, fallback)
     _emit_hotcache_metric(platform, fallback)
+    _emit_soak_metric(platform, fallback)
 
 
 if __name__ == "__main__":

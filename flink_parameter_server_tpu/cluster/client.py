@@ -69,6 +69,20 @@ harmlessly but would waste budget).  Against a pre-hotcache server the
 first ``err bad-request`` flips the client to plain pulls for good —
 the protocol-versioning downgrade path.
 
+Overload control (loadgen/overload.py, docs/loadgen.md): an attached
+``retry_budget`` (token bucket) is spent one token per replay round
+and refilled by successes — exhausted, the batch FAILS FAST with
+``RetryBudgetExhausted`` instead of feeding a retry storm.  A
+``breakers`` board keys one circuit breaker per shard: enough
+transport/shed failures inside the window OPEN the circuit and this
+client's frames to that shard become local rejects (no wire) until a
+half-open probe succeeds.  A shard's ``err overloaded`` shed answer
+raises the typed ``OverloadedError`` immediately — shed traffic is
+badput to count, never a replay.  ``priority=`` tags every frame
+``pr=<n>`` so the shard edge sheds serving reads before training
+pushes.  Retry volume is visible on /metrics as
+``client_retries_total{verb,reason}``.
+
 Replica-chain read routing (replication/, docs/elastic.md): when the
 membership view carries ``replicas`` (or a static ``replicas=`` is
 passed), pulls round-robin across ``[primary] + followers`` per shard.
@@ -94,6 +108,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.api import ParameterServerClient
+from ..loadgen.overload import OverloadedError, RetryBudgetExhausted
 from ..ops.dedup import aggregate_deltas, coalesce_ids
 from ..telemetry.distributed import TraceContext, format_token, new_trace
 from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
@@ -239,6 +254,15 @@ def _is_reject(resp: str) -> bool:
     )
 
 
+def _is_overloaded(resp: str) -> bool:
+    """The shard's typed shed answer (loadgen/overload.py
+    ``OverloadGuard``): the request was REJECTED under load pressure,
+    deliberately and cheaply.  The client fails fast with
+    :class:`~..loadgen.overload.OverloadedError` — retrying a shed
+    would feed exactly the storm the shed exists to stop."""
+    return resp.startswith("err overloaded")
+
+
 def _is_follower_reject(resp: str) -> bool:
     """A replica-chain follower declining a read: lagging past the
     staleness bound, or no longer a follower at all.  The client falls
@@ -252,11 +276,13 @@ def _is_follower_reject(resp: str) -> bool:
 class _Rejected(Exception):
     """Internal: carries the ids a shard rejected (stale-epoch/frozen)
     or could not be reached for, so the batch loop replays exactly
-    those under a refreshed map."""
+    those under a refreshed map.  ``reason`` labels the retry counter
+    (stale-epoch | frozen | conn | breaker_open)."""
 
-    def __init__(self, ids: np.ndarray):
-        super().__init__(f"{len(ids)} ids rejected")
+    def __init__(self, ids: np.ndarray, reason: str = "reject"):
+        super().__init__(f"{len(ids)} ids rejected ({reason})")
         self.ids = ids
+        self.reason = reason
 
 
 class _LeaseUnsupported(Exception):
@@ -300,6 +326,9 @@ class ClusterClient(ParameterServerClient):
         retry_timeout: float = 30.0,
         retry_sleep_s: float = 0.002,
         retry_sleep_cap_s: float = 0.05,
+        retry_budget=None,
+        breakers=None,
+        priority: Optional[int] = None,
         tracer=None,
         flightrec=None,
         storm_threshold: int = 25,
@@ -353,6 +382,16 @@ class ClusterClient(ParameterServerClient):
         self.retry_timeout = float(retry_timeout)
         self.retry_sleep_s = float(retry_sleep_s)
         self.retry_sleep_cap_s = float(retry_sleep_cap_s)
+        # overload control (loadgen/overload.py, docs/loadgen.md):
+        # retry_budget = token bucket over replay rounds (exhausted →
+        # RetryBudgetExhausted fails fast instead of feeding a retry
+        # storm); breakers = per-shard circuit BreakerBoard (an open
+        # shard's frames become rejects without touching the wire);
+        # priority rides frames as pr=<n> so the shard-edge guard can
+        # shed serving traffic before training pushes
+        self.retry_budget = retry_budget
+        self.breakers = breakers
+        self._priority = None if priority is None else int(priority)
         # retry backoff state: decorrelated-jitter sleeps need the
         # previous draw, and each client needs its OWN stream — a herd
         # of workers replaying into a recovering shard must disperse,
@@ -406,6 +445,11 @@ class ClusterClient(ParameterServerClient):
 
             reg = registry if registry is not None else get_registry()
             labels = {"worker": worker} if worker is not None else {}
+            # stash for the on-demand retry counters (_await_retry):
+            # client_retries_total{verb,reason} label pairs are only
+            # known at retry time
+            self._reg = reg
+            self._labels = dict(labels)
             self._h_rtt = reg.histogram(
                 "cluster_pull_rtt_seconds", component="cluster", **labels
             )
@@ -441,6 +485,8 @@ class ClusterClient(ParameterServerClient):
             else:
                 self._c_replica_reads = self._c_fallbacks = None
         else:
+            self._reg = None
+            self._labels = {}
             self._h_rtt = None
             self._c_refresh = None
             self._c_storms = None
@@ -582,20 +628,46 @@ class ClusterClient(ParameterServerClient):
         self._last_retry_sleep = sleep
         return sleep
 
-    def _await_retry(self, deadline: float, attempt: int, what: str) -> None:
+    def _await_retry(
+        self, deadline: float, attempt: int, what: str,
+        reason: str = "reject",
+    ) -> None:
         """Between replay rounds: refresh the view; if nothing changed,
         sleep briefly (the flip/replacement is in flight) — bounded by
-        ``retry_timeout`` so a wedged cluster still surfaces."""
+        ``retry_timeout`` so a wedged cluster still surfaces.  Each
+        round is counted (``client_retries_total{verb,reason}`` —
+        retry volume was invisible on /metrics before this) and spends
+        one retry-budget token when a budget is attached; an exhausted
+        budget FAILS FAST instead of feeding the storm."""
         if self.membership is None:
             raise RuntimeError(
                 f"{what}: shard rejected the frame and no membership "
                 f"view is attached (static client cannot re-route)"
             )
+        if self._reg is not None:
+            self._reg.counter(
+                "client_retries_total", component="cluster",
+                verb=what, reason=reason, **self._labels,
+            ).inc()
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"{what}: retried past retry_timeout="
                 f"{self.retry_timeout}s without converging on a "
                 f"servable map"
+            )
+        # only STORM-CLASS retries spend budget: connection failures
+        # and open breakers are the signals that amplify under
+        # overload.  Epoch-flip replays (stale-epoch/frozen) are the
+        # elastic control plane working as designed — rate-limiting
+        # those would turn every resize into artificial sheds.
+        if (
+            self.retry_budget is not None
+            and reason in ("conn", "breaker_open")
+            and not self.retry_budget.try_spend()
+        ):
+            raise RetryBudgetExhausted(
+                f"{what}: retry budget exhausted after {attempt} "
+                f"replay rounds (reason: {reason}) — failing fast"
             )
         if self._storm is not None and self._storm.note():
             # many reject-driven retries inside the window: the flip is
@@ -663,6 +735,7 @@ class ClusterClient(ParameterServerClient):
             while todo.size:
                 by_shard = self._split(todo)
                 rejected: List[np.ndarray] = []
+                reasons: List[str] = []
                 rej_lock = threading.Lock()
 
                 def do(s, sids):
@@ -671,6 +744,7 @@ class ClusterClient(ParameterServerClient):
                     except _Rejected as r:
                         with rej_lock:
                             rejected.append(r.ids)
+                            reasons.append(r.reason)
                         return
                     flat[np.searchsorted(unique, sids)] = rows.reshape(
                         len(sids), width
@@ -684,7 +758,11 @@ class ClusterClient(ParameterServerClient):
                 if todo.size:
                     attempt += 1
                     self.frames_retried += 1
-                    self._await_retry(deadline, attempt, "pull")
+                    self._await_retry(
+                        deadline, attempt, "pull", reason=reasons[0]
+                    )
+        if self.retry_budget is not None:
+            self.retry_budget.on_success()
         out = flat.reshape(unique.shape + self.value_shape)
         return out[inverse]
 
@@ -722,6 +800,7 @@ class ClusterClient(ParameterServerClient):
             while todo_ids.size:
                 by_shard = self._split(todo_ids)
                 rejected: List[np.ndarray] = []
+                reasons: List[str] = []
                 rej_lock = threading.Lock()
 
                 def do(s, sids):
@@ -731,6 +810,7 @@ class ClusterClient(ParameterServerClient):
                     except _Rejected as r:
                         with rej_lock:
                             rejected.append(r.ids)
+                            reasons.append(r.reason)
 
                 self._for_each_shard(by_shard, do)
                 done = todo_ids.size - sum(len(r) for r in rejected)
@@ -743,9 +823,13 @@ class ClusterClient(ParameterServerClient):
                     todo_ids = retry
                     attempt += 1
                     self.frames_retried += 1
-                    self._await_retry(deadline, attempt, "push")
+                    self._await_retry(
+                        deadline, attempt, "push", reason=reasons[0]
+                    )
                 else:
                     todo_ids = np.empty(0, np.int64)
+        if self.retry_budget is not None:
+            self.retry_budget.on_success()
         return int(unique.size)
 
     def flush(self) -> List[str]:
@@ -863,6 +947,11 @@ class ClusterClient(ParameterServerClient):
             suffix += f" pid={pid}"
         if self._epoch is not None:
             suffix += f" e={self._epoch}"
+        if self._priority is not None:
+            # overload-plane priority tag (loadgen/overload.py): the
+            # shard-edge guard sheds pr=2 (serving) traffic first and
+            # never sheds pr=0; old servers parse-and-ignore
+            suffix += f" pr={self._priority}"
         if self.hotcache is not None and self._sess is not None:
             # declares a lease-capable session: responses may carry
             # piggybacked inv= tokens (old servers parse-and-ignore)
@@ -890,7 +979,12 @@ class ClusterClient(ParameterServerClient):
         elastic mode becomes a :class:`_Rejected` (drop the cached
         connection, let the batch loop refresh + replay) instead of an
         error — the client sees latency while the controller replaces
-        the shard."""
+        the shard.  With a breaker board attached, an OPEN shard's
+        frames become rejects WITHOUT touching the wire (fail fast;
+        the half-open probe is the only traffic an open shard sees)."""
+        board = self.breakers
+        if board is not None and not board.allow(shard):
+            raise _Rejected(sids, "breaker_open")
         try:
             conn = self._conn_for(shard)
             if hedgeable and self.hedge is not None:
@@ -905,7 +999,7 @@ class ClusterClient(ParameterServerClient):
                         old.close()
                     self._conns[addr] = spare_conn
 
-                return self.hedge.request_many(
+                resps = self.hedge.request_many(
                     conn,
                     lambda: ShardConnection(
                         addr[0], addr[1], window=self._window,
@@ -916,12 +1010,20 @@ class ClusterClient(ParameterServerClient):
                     on_backup_won,
                     trace=trace,
                 )
-            return conn.request_many(lines)
+            else:
+                resps = conn.request_many(lines)
         except OSError:
+            # transport failure feeds the breaker (a dead/wedged shard
+            # opens its circuit after enough of these in the window)
+            if board is not None:
+                board.fail(shard)
             if self.membership is None:
                 raise
             self._drop_conn(shard)
-            raise _Rejected(sids) from None
+            raise _Rejected(sids, "conn") from None
+        if board is not None:
+            board.ok(shard)
+        return resps
 
     def _read_frames(
         self, shard: int, sids: np.ndarray, lines: List[str], *,
@@ -1018,8 +1120,8 @@ class ClusterClient(ParameterServerClient):
                 # rest of this client's life (never re-probed)
                 self._lease_supported = False
                 return self._pull_shard_wire(shard, ids, ctx)
-        except _Rejected:
-            raise _Rejected(ids) from None
+        except _Rejected as r:
+            raise _Rejected(ids, r.reason) from None
         out[hot] = hot_rows
         if cold_rows is not None:
             out[~hot] = cold_rows
@@ -1053,6 +1155,7 @@ class ClusterClient(ParameterServerClient):
         hot_rows: List[np.ndarray] = []
         cold_rows: List[np.ndarray] = []
         rejected = False
+        reject_reason = "reject"
         with span_cm:
             lines = [
                 "lease " + ",".join(str(int(i)) for i in c)
@@ -1078,8 +1181,19 @@ class ClusterClient(ParameterServerClient):
             )):
                 is_lease = i < n_hot
                 resp = self._apply_response_options(resp)
+                if _is_overloaded(resp):
+                    if self.breakers is not None:
+                        self.breakers.fail(shard)
+                    raise OverloadedError(
+                        f"{'lease' if is_lease else 'pull'} shard "
+                        f"{shard}: {resp}"
+                    )
                 if _is_reject(resp) and self.membership is not None:
                     rejected = True
+                    reject_reason = (
+                        "frozen" if resp.startswith("err frozen")
+                        else "stale-epoch"
+                    )
                     continue
                 if is_lease and resp.startswith("err bad-request"):
                     raise _LeaseUnsupported(resp)
@@ -1114,7 +1228,7 @@ class ClusterClient(ParameterServerClient):
                 else:
                     cold_rows.append(vals)
         if rejected:
-            raise _Rejected(all_ids)
+            raise _Rejected(all_ids, reject_reason)
         hot_out = np.concatenate(hot_rows) if hot_rows else np.empty(
             (0,) + self.value_shape, np.float32
         )
@@ -1138,6 +1252,7 @@ class ClusterClient(ParameterServerClient):
         )
         rows = []
         rejected: List[np.ndarray] = []
+        reject_reason = "reject"
         # the pull.shard<k> span covers the WHOLE per-shard round —
         # serialize, wire round trip, response parse — which makes it
         # the independent oracle the latency-budget phases (observed
@@ -1166,8 +1281,19 @@ class ClusterClient(ParameterServerClient):
                     # piggybacked inv= tokens ride any response to a
                     # lease-capable session — strip and apply first
                     resp = self._apply_response_options(resp)
+                if _is_overloaded(resp):
+                    # typed shed: fail fast (count badput, never
+                    # retry the storm); the breaker sees it as a
+                    # failure signal on this shard
+                    if self.breakers is not None:
+                        self.breakers.fail(shard)
+                    raise OverloadedError(f"pull shard {shard}: {resp}")
                 if _is_reject(resp) and self.membership is not None:
                     rejected.append(c)
+                    reject_reason = (
+                        "frozen" if resp.startswith("err frozen")
+                        else "stale-epoch"
+                    )
                     continue
                 _check_ok(resp, f"pull shard {shard}")
                 _, _, body = resp.partition(" ")
@@ -1184,7 +1310,7 @@ class ClusterClient(ParameterServerClient):
             # partial answers cannot scatter into the output without
             # per-chunk bookkeeping; pulls are idempotent, so replay
             # the shard's whole id set under the refreshed map
-            raise _Rejected(ids)
+            raise _Rejected(ids, reject_reason)
         return np.concatenate(rows) if rows else np.empty(
             (0,) + self.value_shape, np.float32
         )
@@ -1227,15 +1353,24 @@ class ClusterClient(ParameterServerClient):
                 prof.observe("push", "rtt", per)
                 prof.observe("push", "client_serialize", ser_per)
         rejected: List[np.ndarray] = []
+        reject_reason = "reject"
         for resp, c_ids in zip(resps, chunks):
             if self.hotcache is not None:
                 resp = self._apply_response_options(resp)
+            if _is_overloaded(resp):
+                if self.breakers is not None:
+                    self.breakers.fail(shard)
+                raise OverloadedError(f"push shard {shard}: {resp}")
             if _is_reject(resp) and self.membership is not None:
                 rejected.append(c_ids)
+                reject_reason = (
+                    "frozen" if resp.startswith("err frozen")
+                    else "stale-epoch"
+                )
                 continue
             _check_ok(resp, f"push shard {shard}")
         if rejected:
-            raise _Rejected(np.concatenate(rejected))
+            raise _Rejected(np.concatenate(rejected), reject_reason)
 
 
 __all__ = ["ClusterClient", "ShardConnection"]

@@ -43,7 +43,15 @@ contract for observability options)::
                                           # durable segment + end seq)
     ok pushes=<n> wal_records=<m>         # flush answer
     err <reason>      # bad-request | crashed | stale-epoch | frozen
-                      # | lagging | not-primary | internal
+                      # | lagging | not-primary | overloaded | internal
+
+Overload shedding (loadgen/overload.py, docs/loadgen.md): with an
+``OverloadGuard`` attached to the server, frames may be answered
+``err overloaded`` BEFORE parsing once the live request depth passes
+the guard's thresholds — serving/lease reads shed first, training
+pushes never (by default).  Frames may carry a ``pr=<n>`` priority
+option (0 critical, 1 normal, 2 sheddable); old servers parse and
+ignore it, the same trailing-token contract as ``sess=``/``t=``.
 
 Epoch fencing (the elastic/ membership protocol, docs/elastic.md): a
 shard pins the partition-map epoch it serves.  A push whose frame
@@ -1032,6 +1040,7 @@ class ShardServer(LineServer):
         max_line_bytes: int = 64 << 20,
         tracer=None,
         profiler=None,
+        overload=None,
     ):
         super().__init__(
             host, port, name=f"shard-{shard.shard_id}",
@@ -1039,6 +1048,13 @@ class ShardServer(LineServer):
         )
         self.shard = shard
         self.supervised = supervised
+        # overload-plane admission (loadgen/overload.OverloadGuard):
+        # with a guard attached, sheddable frames are answered
+        # ``err overloaded`` BEFORE parse/lock/apply once the live
+        # request depth passes the guard's thresholds — serving/lease
+        # reads shed first, training pushes never (by default).  None
+        # = admit everything (the pre-overload behaviour).
+        self.overload = overload
         # latency-budget phases (telemetry/profiler.py): whole-request
         # server wall (the "wire" residual's subtrahend), inbound parse
         # and response serialize — default to the shard's profiler so
@@ -1068,12 +1084,39 @@ class ShardServer(LineServer):
         self._rng = np.random.default_rng(self.policy.seed)
 
     # -- the protocol ------------------------------------------------------
+    @staticmethod
+    def _frame_priority(toks) -> Optional[int]:
+        """The ``pr=<n>`` priority token from a frame's trailing
+        options (scanned from the end, same discipline as
+        :meth:`_inbound_trace`: payload tokens stop the scan).
+        Malformed values yield None — priority must never be able to
+        fail a request."""
+        for t in reversed(toks[1:]):
+            k, sep, v = t.partition("=")
+            if not sep or not k.isalnum():
+                break
+            if k == "pr":
+                try:
+                    return int(v)
+                except ValueError:
+                    return None
+        return None
+
     def respond(self, line: str) -> str:
         with self.shard._depth_lock:
             self.shard._active_requests += 1
+            depth = self.shard._active_requests
         verb = line.split(None, 1)[0].lower() if line else ""
         t0 = time.perf_counter()
         try:
+            guard = self.overload
+            if guard is not None and not guard.admit(
+                verb, self._frame_priority(line.split()), depth
+            ):
+                # typed shed (docs/loadgen.md): rejected before the
+                # request pays parse/lock/apply — overload must make
+                # rejection the CHEAPEST path through the server
+                return "err overloaded"
             return self._respond_supervised(line)
         finally:
             with self.shard._depth_lock:

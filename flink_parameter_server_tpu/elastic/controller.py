@@ -363,6 +363,14 @@ class ScalePolicy:
     scale_out_staleness: Optional[int] = None  # None = staleness off
     min_window_frames: int = 50  # don't act on a starved window
     cooldown_s: float = 5.0
+    # scale-in hysteresis: require this many CONSECUTIVE idle
+    # evaluations before shrinking (1 = act on the first, the
+    # pre-soak behaviour).  Oscillating load at the scale boundary
+    # flips the windowed p99 above/below the thresholds every window;
+    # cooldown bounds the action RATE, this bounds the decision —
+    # one noisy idle window must not retire a shard the next window
+    # would want back (tests/test_loadgen.py flapping regression).
+    scale_in_consecutive: int = 1
 
 
 def _percentile_from_counts(bounds, counts, q: float) -> float:
@@ -429,6 +437,7 @@ class ElasticController:
         self.events: List[dict] = []
         self._seen_buckets: Dict[int, List[int]] = {}
         self._last_action_t = -float("inf")
+        self._idle_streak = 0  # consecutive idle windows (hysteresis)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -512,22 +521,35 @@ class ElasticController:
             )
             or bool(slo_breaches)
         )
-        if pressured and n < pol.max_shards:
-            decision = {
-                "action": "scale_out", "p99_s": p99, "depth": depth,
-                "staleness": staleness, "frames": frames,
-                "slo_breaches": slo_breaches,
-            }
-        elif (
+        idle = (
             p99 is not None
             and frames >= pol.min_window_frames
             and p99 < pol.scale_in_rtt_p99_s
             and depth <= 1.0
-            and n > pol.min_shards
-        ):
-            decision = {
-                "action": "scale_in", "p99_s": p99, "frames": frames,
-            }
+        )
+        if pressured:
+            self._idle_streak = 0
+            if n < pol.max_shards:
+                decision = {
+                    "action": "scale_out", "p99_s": p99, "depth": depth,
+                    "staleness": staleness, "frames": frames,
+                    "slo_breaches": slo_breaches,
+                }
+        elif idle:
+            # hysteresis: one idle window is a data point, not a
+            # decision — shrink only after scale_in_consecutive of
+            # them in a row (flapping load resets the streak above)
+            self._idle_streak += 1
+            if (
+                self._idle_streak >= pol.scale_in_consecutive
+                and n > pol.min_shards
+            ):
+                decision = {
+                    "action": "scale_in", "p99_s": p99, "frames": frames,
+                    "idle_streak": self._idle_streak,
+                }
+        else:
+            self._idle_streak = 0
         return decision
 
     def step(self) -> Optional[dict]:
@@ -557,6 +579,7 @@ class ElasticController:
                 decision["report_rows"] = self.driver.scale_out().rows_moved
             elif decision["action"] == "scale_in":
                 decision["report_rows"] = self.driver.scale_in().rows_moved
+                self._idle_streak = 0  # fresh streak per shrink
             decision["ok"] = True
         except Exception as e:  # noqa: BLE001 — policy must not die
             decision["ok"] = False

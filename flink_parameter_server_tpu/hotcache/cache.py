@@ -103,6 +103,13 @@ class HotRowCache:
         # load.  Jittered bounds only ever SHORTEN a lease, so the
         # staleness contract (age ≤ bound) is untouched.
         self.jitter_frac = float(jitter_frac)
+        # brownout widening (loadgen/overload.BrownoutController,
+        # docs/loadgen.md): under shed pressure the controller widens
+        # the served-age bound to ``entry.bound × widen`` — degraded
+        # freshness instead of errors, still a REAL bound the
+        # lease_staleness checker enforces (at the widened value).
+        # 1.0 = normal operation.
+        self._widen = 1.0
         self._lock = threading.Lock()
         self._entries: Dict[int, _Entry] = {}
         self._tick = 0
@@ -162,13 +169,14 @@ class HotRowCache:
         now = time.monotonic()
         n_hit = n_miss = 0
         with self._lock:
+            widen = self._widen
             for gid in ids.tolist():
                 e = self._entries.get(gid)
                 if e is None:
                     n_miss += 1
                     continue
                 age = self._tick - e.tick
-                if age > e.bound or (
+                if age > int(e.bound * widen) or (
                     self.ttl_s is not None
                     and now - e.t_wall > self.ttl_s
                 ):
@@ -244,6 +252,24 @@ class HotRowCache:
     def clear(self) -> None:
         self.invalidate(None)
 
+    # -- brownout (loadgen/overload.BrownoutController) ----------------------
+    def set_widen(self, mult: float) -> None:
+        """Scale the served-age bound by ``mult`` (≥ 1; 1 restores
+        normal operation).  Entries aged past their own bound but
+        inside ``bound × mult`` become servable again — the degraded
+        tier under overload.  The caller owns proving the widened
+        bound still holds (``max_served_age`` keeps tracking)."""
+        m = float(mult)
+        if m < 1.0:
+            raise ValueError(f"widen mult={mult}: must be >= 1")
+        with self._lock:
+            self._widen = m
+
+    @property
+    def widen_mult(self) -> float:
+        with self._lock:
+            return self._widen
+
     # -- monitoring ----------------------------------------------------------
     def __len__(self) -> int:
         with self._lock:
@@ -267,6 +293,8 @@ class HotRowCache:
                 "stale_rejects": self.stale_rejects,
                 "evictions": self.evictions,
                 "max_served_age": self.max_served_age,
+                "widen_mult": self._widen,
+                "effective_bound": int(self.bound * self._widen),
             }
 
     def snapshot(self, n: int = 32) -> Dict[str, object]:

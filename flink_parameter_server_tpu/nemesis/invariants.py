@@ -53,7 +53,7 @@ import numpy as np
 _OWNED_THREAD_PREFIXES = (
     "shard-", "nemesis-", "cluster-", "elastic-", "repl-", "serving",
     "chaos", "line-server", "wal-", "hb-", "ship-", "telemetry",
-    "hotcache-",
+    "hotcache-", "loadgen-",
 )
 
 

@@ -33,6 +33,14 @@ class QueueFull(RuntimeError):
     """Admission queue at capacity: the request was rejected, not queued."""
 
 
+class DeadlineExceeded(RuntimeError):
+    """The request waited in the admission queue past its deadline:
+    answering it now would hand the client a result it has already
+    given up on, so it is failed instead of served — the queue drains
+    at the cost of badput, not of growing latency for everyone
+    (``err deadline`` on the serving wire)."""
+
+
 def pow2_bucket(n: int, cap: int) -> int:
     """Smallest power of two >= n, capped at ``cap``."""
     b = 1
@@ -66,15 +74,24 @@ class RequestBatcher:
         max_batch: int = 64,
         max_delay_ms: float = 2.0,
         max_queue: int = 256,
+        deadline_ms: Optional[float] = None,
         buckets: Optional[Sequence[int]] = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch={max_batch}: must be >= 1")
         if max_queue < 1:
             raise ValueError(f"max_queue={max_queue}: must be >= 1")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(f"deadline_ms={deadline_ms}: must be > 0")
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1e3
         self.max_queue = int(max_queue)
+        # per-request queue-wait deadline (seconds); the dispatch loop
+        # fails expired requests with DeadlineExceeded instead of
+        # serving answers nobody is waiting for.  None = no deadline.
+        self.deadline_s = (
+            None if deadline_ms is None else float(deadline_ms) / 1e3
+        )
         if buckets is None:
             buckets = []
             b = 1
@@ -185,4 +202,10 @@ class RequestBatcher:
             self._cond.notify_all()
 
 
-__all__ = ["QueueFull", "RequestBatcher", "PendingRequest", "pow2_bucket"]
+__all__ = [
+    "DeadlineExceeded",
+    "QueueFull",
+    "RequestBatcher",
+    "PendingRequest",
+    "pow2_bucket",
+]

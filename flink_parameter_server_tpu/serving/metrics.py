@@ -51,6 +51,7 @@ class ServingMetrics:
         self.registry = None
         self._c_requests = self._c_batches = None
         self._c_rejected = self._h_latency = None
+        self._c_reject_reason: Dict[str, Any] = {}
         if registry is not None:
             self.bind_registry(registry)
 
@@ -68,6 +69,17 @@ class ServingMetrics:
         self._c_rejected = registry.counter(
             "serving_rejected_total", component="serving"
         )
+        # per-cause admission rejects (the soak's badput attribution,
+        # docs/loadgen.md): queue_full = hard capacity, deadline =
+        # queue wait blew the request deadline, shed = deliberate
+        # overload shedding below the hard line.  Pre-registered so
+        # /metrics shows zeros from the first scrape.
+        self._c_reject_reason = {
+            r: registry.counter(
+                "serving_rejected_total", component="serving", reason=r
+            )
+            for r in ("queue_full", "deadline", "shed")
+        }
         self._h_latency = registry.histogram(
             "serving_latency_seconds", component="serving"
         )
@@ -110,11 +122,14 @@ class ServingMetrics:
             for lat in latencies_s:
                 self._h_latency.observe(lat)
 
-    def record_reject(self, n: int = 1) -> None:
+    def record_reject(self, n: int = 1, reason: str = "queue_full") -> None:
         with self._lock:
             self.total_rejected += n
         if self._c_rejected is not None:
             self._c_rejected.inc(n)
+            counter = self._c_reject_reason.get(reason)
+            if counter is not None:
+                counter.inc(n)
 
     # -- reporting ---------------------------------------------------------
     def qps(self) -> float:

@@ -16,7 +16,8 @@ order, per connection)::
 
     ok v=<version> step=<train_step> stale=<staleness> <payload>
     err <reason>                            # bad-request | overloaded |
-                                            # no-snapshot | internal
+                                            # deadline | no-snapshot |
+                                            # internal
 
 ``topk`` payload: ``<item_id>:<score>`` space-separated (k entries;
 lanes with no real candidate are ``-1:-inf``).  ``pull`` payload: one
@@ -39,7 +40,13 @@ import numpy as np
 
 from ..core.store import ShardedParamStore, StoreSpec
 from ..utils.net import LineServer
-from .batcher import PendingRequest, QueueFull, RequestBatcher, pow2_bucket
+from .batcher import (
+    DeadlineExceeded,
+    PendingRequest,
+    QueueFull,
+    RequestBatcher,
+    pow2_bucket,
+)
 from .engine import LookupResult, NoSnapshotError, QueryEngine, TopKResult
 from .metrics import ServingMetrics
 from .snapshot import SnapshotManager
@@ -76,9 +83,16 @@ class ServingService:
         metrics: Optional[ServingMetrics] = None,
         registry=None,
         hotkeys=None,
+        shedder=None,
     ):
         self.engine = engine
         self.snapshots = engine.snapshots
+        # overload-plane admission (loadgen/overload.LoadShedder):
+        # with a shedder attached, requests are shed in the submit
+        # path once the queue passes the shedder's depth fraction —
+        # BELOW the hard QueueFull line, so rejection is cheap and
+        # early (counted reason="shed" vs the hard "queue_full")
+        self.shedder = shedder
         # hot-key analytics (telemetry/hotkeys.py): with a sketch
         # attached, every served lookup's requested ids are observed —
         # the serving-side half of the Zipf-skew measurement (register
@@ -193,24 +207,40 @@ class ServingService:
             time.sleep(0.005)
 
     # -- admission ---------------------------------------------------------
+    def _admit_shed(self) -> None:
+        """The shed gate (loadgen/overload.py): deliberate rejection
+        below the hard capacity line once the queue is deep enough —
+        raised as :class:`QueueFull` so every existing caller's
+        backoff path applies unchanged, counted as its own cause."""
+        if self.shedder is not None and not self.shedder.admit(
+            self.batcher.depth, self.batcher.max_queue
+        ):
+            self.metrics.record_reject(reason="shed")
+            raise QueueFull(
+                "serving admission shed under overload pressure; "
+                "retry with backoff or degrade"
+            )
+
     def submit_topk(
         self, user: int, k: int = 10, exclude: Sequence[int] = ()
     ) -> Future:
+        self._admit_shed()
         try:
             return self.batcher.submit(
                 _TopKQuery(int(user), int(k), tuple(int(e) for e in exclude))
             )
         except QueueFull:
-            self.metrics.record_reject()
+            self.metrics.record_reject(reason="queue_full")
             raise
 
     def submit_lookup(self, ids: Sequence[int]) -> Future:
+        self._admit_shed()
         try:
             return self.batcher.submit(
                 _LookupQuery(tuple(int(i) for i in ids))
             )
         except QueueFull:
-            self.metrics.record_reject()
+            self.metrics.record_reject(reason="queue_full")
             raise
 
     def client(self) -> "ServingClient":
@@ -238,6 +268,22 @@ class ServingService:
                         p.future.set_exception(e)
 
     def _serve_batch(self, batch: List[PendingRequest]) -> None:
+        dl = self.batcher.deadline_s
+        if dl is not None:
+            # fail requests whose queue wait already blew the deadline
+            # — serving them would return answers nobody is waiting
+            # for while fresher requests queue behind them
+            now = time.monotonic()
+            expired = [p for p in batch if now - p.t_submit > dl]
+            if expired:
+                batch = [p for p in batch if now - p.t_submit <= dl]
+                self.metrics.record_reject(len(expired), reason="deadline")
+                for p in expired:
+                    if not p.future.done():
+                        p.future.set_exception(DeadlineExceeded(
+                            f"queued {now - p.t_submit:.3f}s > deadline "
+                            f"{dl:.3f}s"
+                        ))
         topks = [p for p in batch if isinstance(p.payload, _TopKQuery)]
         lookups = [p for p in batch if isinstance(p.payload, _LookupQuery)]
         others = [
@@ -469,6 +515,10 @@ class ServingServer(LineServer):
                 res = fut.result(self.request_timeout)
         except NoSnapshotError:
             return "err no-snapshot"
+        except DeadlineExceeded:
+            # the request outlived its queue-wait deadline: a typed
+            # overload outcome the client can count as badput
+            return "err deadline"
         except Exception as e:
             return f"err internal: {type(e).__name__}: {e}"
         with prof.timer(verb, "response_serialize"):

@@ -32,6 +32,22 @@ def _sum_counter(snapshot: Dict[str, Any], name: str) -> float:
     )
 
 
+def _reject_counts(snapshot: Dict[str, Any]) -> tuple:
+    """(total, {reason: n}) for serving_rejected_total: the aggregate
+    (unlabelled) instrument and the per-cause breakdown share the
+    metric name, so a blind name-sum would double-count."""
+    total = 0.0
+    by_reason: Dict[str, int] = {}
+    for s in snapshot.get("serving_rejected_total", ()):
+        reason = (s.get("labels") or {}).get("reason")
+        v = s["value"] or 0.0
+        if reason is None:
+            total += v
+        else:
+            by_reason[reason] = by_reason.get(reason, 0) + int(v)
+    return total, by_reason
+
+
 def _hist_percentiles(registry: MetricsRegistry, name: str) -> Dict[str, Any]:
     for inst in registry.instruments():
         if inst.name == name and inst.kind == "histogram" and inst.count:
@@ -80,7 +96,8 @@ def build_run_report(
         },
         "serving": {
             "requests": int(_sum_counter(snap, "serving_requests_total")),
-            "rejected": int(_sum_counter(snap, "serving_rejected_total")),
+            "rejected": int(_reject_counts(snap)[0]),
+            "rejected_by_reason": _reject_counts(snap)[1],
             "qps": _find(snap, "serving_qps", component="serving"),
             "latency": _hist_percentiles(reg, "serving_latency_seconds"),
             "batch_fill": _find(

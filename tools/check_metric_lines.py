@@ -25,12 +25,13 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Three further artifact shapes from the observability plane lint here
-too (docs/observability.md):
+Four further artifact shapes from the observability plane lint here
+too (docs/observability.md, docs/loadgen.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
     python tools/check_metric_lines.py --flightrec flightrec_stall.json
     python tools/check_metric_lines.py --budget budget.json
+    python tools/check_metric_lines.py --soak soak_capacity.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -43,7 +44,13 @@ latency-budget artifact (telemetry/profiler.py
 ``write_budget_artifact``): ts/run_id stamped, every budget carries a
 non-empty phase list with numeric ``p50_ms``/``pct``, and for any
 verb with full coverage the phase percentages sum to 100 ± 10 — the
-additivity contract the profiler's decomposition promises.  A mode
+additivity contract the profiler's decomposition promises.  ``--soak``
+checks a soak-capacity artifact (benchmarks/soak_capacity.py,
+docs/loadgen.md): ts/run_id stamped, every arm declares
+``latency_anchor: "arrival"`` (the coordinated-omission-free contract)
+with numeric arrival-anchored percentiles, the goodput ledger sums
+(``arrivals == ok + late + shed + error``), the capacity curve rows
+carry numeric rates, and the autoscaler score stays in [0, 1].  A mode
 flag applies to the paths that follow it.
 """
 from __future__ import annotations
@@ -59,7 +66,7 @@ from typing import Any, Iterable, List, Tuple
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
-     "replication", "nemesis", "hotcache"}
+     "replication", "nemesis", "hotcache", "loadgen"}
 )
 
 
@@ -240,6 +247,77 @@ def check_budget(doc: Any) -> List[str]:
     return bad
 
 
+def check_soak(doc: Any) -> List[str]:
+    """Lint a soak-capacity artifact (benchmarks/soak_capacity.py
+    format, docs/loadgen.md "Artifact schema")."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"soak document is {type(doc).__name__}, expected a "
+                f"JSON object"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        bad.append("missing/non-numeric 'ts'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    soak = doc.get("soak")
+    if not isinstance(soak, dict):
+        bad.append("missing/non-object 'soak'")
+        return bad
+    arms = soak.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        bad.append("missing/empty 'soak.arms'")
+    else:
+        for name, arm in arms.items():
+            if not isinstance(arm, dict):
+                bad.append(f"arm {name!r}: not an object")
+                continue
+            if arm.get("latency_anchor") != "arrival":
+                bad.append(
+                    f"arm {name!r}: latency_anchor must be 'arrival' "
+                    f"(open-loop honesty — got "
+                    f"{arm.get('latency_anchor')!r})"
+                )
+            for field in ("p50_ms", "p99_ms", "goodput_rps"):
+                if not isinstance(arm.get(field), (int, float)):
+                    bad.append(
+                        f"arm {name!r}: missing/non-numeric {field!r}"
+                    )
+            counts = [arm.get(o) for o in ("ok", "late", "shed", "error")]
+            arrivals = arm.get("arrivals")
+            if not all(isinstance(c, int) for c in counts) or not \
+                    isinstance(arrivals, int):
+                bad.append(
+                    f"arm {name!r}: ledger fields (arrivals/ok/late/"
+                    f"shed/error) must be integers"
+                )
+            elif sum(counts) != arrivals:
+                bad.append(
+                    f"arm {name!r}: goodput ledger does not balance — "
+                    f"arrivals={arrivals} but ok+late+shed+error="
+                    f"{sum(counts)}"
+                )
+    curve = soak.get("capacity_curve")
+    if not isinstance(curve, list) or not curve:
+        bad.append("missing/empty 'soak.capacity_curve'")
+    else:
+        for i, row in enumerate(curve):
+            if not isinstance(row, dict) or not isinstance(
+                row.get("capacity_rps"), (int, float)
+            ):
+                bad.append(
+                    f"capacity_curve[{i}]: missing/non-numeric "
+                    f"'capacity_rps'"
+                )
+    auto = soak.get("autoscaler")
+    if auto is not None:
+        score = auto.get("score") if isinstance(auto, dict) else None
+        if not isinstance(score, (int, float)) or not 0.0 <= score <= 1.0:
+            bad.append(
+                f"autoscaler.score must be a number in [0, 1] "
+                f"(got {score!r})"
+            )
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -262,6 +340,8 @@ def main(argv: List[str]) -> int:
             mode = "flightrec"
         elif a == "--budget":
             mode = "budget"
+        elif a == "--soak":
+            mode = "soak"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -271,16 +351,18 @@ def main(argv: List[str]) -> int:
             jobs.append((mode, a))
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
-              "[--trace|--flightrec|--budget|--lines] <file|-> ...",
+              "[--trace|--flightrec|--budget|--soak|--lines] "
+              "<file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
-        if mode in ("trace", "flightrec", "budget"):
+        if mode in ("trace", "flightrec", "budget", "soak"):
             checker = {
                 "trace": check_trace_events,
                 "flightrec": check_flightrec,
                 "budget": check_budget,
+                "soak": check_soak,
             }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:

@@ -89,6 +89,7 @@ def default_drift_config(root: str) -> DriftConfig:
                     f"{pkg}/nemesis/runner.py",
                     f"{pkg}/nemesis/scenarios.py",
                     f"{pkg}/hotcache/serving.py",
+                    f"{pkg}/loadgen/soak.py",
                     "tools/psctl.py",
                 ],
                 ("docs/cluster.md", "wire-verbs shard"),
@@ -104,7 +105,7 @@ def default_drift_config(root: str) -> DriftConfig:
         metric_doc_files=docs,
         catalog_doc_files=[
             "docs/observability.md", "docs/cluster.md",
-            "docs/elastic.md",
+            "docs/elastic.md", "docs/loadgen.md",
         ],
         known_components=KNOWN_COMPONENTS,
         metric_scan_prefixes=[pkg + "/"],

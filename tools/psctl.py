@@ -17,6 +17,8 @@ Usage::
     psctl budget --metrics HOST:PORT [--verb pull] [--json]
     psctl hot    --metrics HOST:PORT [--interval 2] [--iterations 0]
                  [-n 16] [--json]
+    psctl slo    --metrics HOST:PORT [--interval 2] [--iterations 0]
+                 [--json]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -33,6 +35,20 @@ operator can see at a glance whether the hotcache tier is absorbing a
 storm or the celebrities are slipping through
 (docs/hotcache.md).  Same ``--interval``/``--iterations``/``--raw``
 loop as ``top``; ``--json`` emits the raw payload once.
+
+``slo`` is the operator view for watching a soak (docs/loadgen.md):
+one row per declared objective (``fps_slo_burn_rate{slo=,window=}`` ×
+``fps_slo_healthy{slo=}`` from the SLOEngine gauges) with its short-
+and long-window burn rates and a verdict, then the overload-plane
+state underneath — admission rejects per cause
+(``fps_serving_rejected_total{reason=}``), shard/serving sheds
+(``fps_overload_shed_total{edge=,verb=}``), open circuit breakers
+(``fps_overload_breaker_open``) and whether brownout is active
+(``fps_brownout_active``).  The verdict column derives from the
+published gauges: healthy 1 → ``ok``; healthy 0 with both burns past
+1 → ``breach``, else ``burning`` (the engine's page_burn threshold is
+not exported, so this is the operator approximation of the
+``SLOEngine`` verdict, not its byte-exact reproduction).
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -370,6 +386,118 @@ def cmd_hot(args) -> int:
         time.sleep(args.interval)
 
 
+def _slo_rows(samples: Dict[Tuple[str, tuple], float]) -> List[List[str]]:
+    """slo × (burn short, burn long, healthy) → verdict table rows."""
+    burns: Dict[str, Dict[str, float]] = {}
+    healthy: Dict[str, float] = {}
+    for (name, labels), v in samples.items():
+        d = dict(labels)
+        if name == "fps_slo_burn_rate" and "slo" in d and "window" in d:
+            burns.setdefault(d["slo"], {})[d["window"]] = v
+        elif name == "fps_slo_healthy" and "slo" in d:
+            healthy[d["slo"]] = v
+    rows: List[List[str]] = []
+    for slo in sorted(set(burns) | set(healthy)):
+        short = burns.get(slo, {}).get("short")
+        long_ = burns.get(slo, {}).get("long")
+        h = healthy.get(slo)
+        if h is None:
+            verdict = "?"
+        elif h >= 1.0:
+            verdict = "ok"
+        elif (short or 0) > 1.0 and (long_ or 0) > 1.0:
+            verdict = "breach"
+        else:
+            verdict = "burning"
+        rows.append([
+            slo,
+            "—" if short is None else f"{short:.2f}",
+            "—" if long_ is None else f"{long_:.2f}",
+            verdict,
+        ])
+    return rows
+
+
+def cmd_slo(args) -> int:
+    host, port = parse_addr(args.metrics)
+    shown = 0
+    while True:
+        try:
+            samples = parse_prometheus(scrape(host, port, "metrics"))
+        except OSError as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        rows = _slo_rows(samples)
+        rejects = {}
+        for (name, labels), v in samples.items():
+            d = dict(labels)
+            if name == "fps_serving_rejected_total" and "reason" in d:
+                rejects[d["reason"]] = rejects.get(d["reason"], 0) + v
+        sheds = {}
+        for (name, labels), v in samples.items():
+            d = dict(labels)
+            if name == "fps_overload_shed_total":
+                key = f"{d.get('edge', '?')}/{d.get('verb', '?')}"
+                sheds[key] = sheds.get(key, 0) + v
+        breakers_open = _sum_named(samples, "fps_overload_breaker_open")
+        brownout = _sum_named(samples, "fps_brownout_active")
+        budget_left = _sum_named(samples, "fps_retry_budget_tokens")
+        if args.json:
+            print(json.dumps({
+                "slos": [
+                    {"slo": r[0], "burn_short": r[1], "burn_long": r[2],
+                     "verdict": r[3]} for r in rows
+                ],
+                "rejects": rejects,
+                "sheds": sheds,
+                "breakers_open": breakers_open,
+                "brownout_active": bool(brownout),
+                "retry_budget_tokens": budget_left,
+            }, indent=2))
+            return 0
+        lines = [
+            f"psctl slo — {host}:{port} — "
+            f"{time.strftime('%H:%M:%S', time.localtime())}",
+            "",
+        ]
+        if rows:
+            lines.append(_render_table(
+                ["slo", "burn short", "burn long", "verdict"], rows
+            ))
+        else:
+            lines.append("(no SLO gauges published — is an SLOEngine "
+                         "registered?)")
+        lines.append("")
+        lines.append(
+            "rejects  " + (
+                "  ".join(
+                    f"{k}={int(v)}" for k, v in sorted(rejects.items())
+                ) or "—"
+            )
+        )
+        lines.append(
+            "sheds    " + (
+                "  ".join(
+                    f"{k}={int(v)}" for k, v in sorted(sheds.items())
+                ) or "—"
+            )
+        )
+        lines.append(
+            f"breakers open {breakers_open:g}    brownout "
+            f"{'ACTIVE' if brownout else 'off'}    retry budget "
+            f"{budget_left:g} tokens"
+        )
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_budget(args) -> int:
     host, port = parse_addr(args.metrics)
     try:
@@ -450,6 +578,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     hot.add_argument("--json", action="store_true",
                      help="emit the raw payload once")
     hot.set_defaults(fn=cmd_hot)
+
+    slo = sub.add_parser(
+        "slo", help="live SLO burn-rate / overload-plane table"
+    )
+    slo.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    slo.add_argument("--interval", type=float, default=2.0)
+    slo.add_argument("--iterations", type=int, default=0,
+                     help="stop after N frames (0 = forever)")
+    slo.add_argument("--raw", action="store_true",
+                     help="no screen clear (pipe/CI friendly)")
+    slo.add_argument("--json", action="store_true",
+                     help="emit the raw payload once")
+    slo.set_defaults(fn=cmd_slo)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
