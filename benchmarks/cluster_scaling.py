@@ -58,6 +58,7 @@ def run_cluster_bench(
     window: int = 8,
     chunk: int = 1_024,
     seed: int = 0,
+    shard_procs: bool = False,
 ) -> dict:
     """Run the shard sweep; returns {"arms": [...], config...}.
 
@@ -85,7 +86,13 @@ def run_cluster_bench(
         num_users, num_items, rounds * batch, seed=seed
     )
     batches = list(microbatches(cols, batch))
-    init = ranged_random_factor(seed + 1, (dim,))
+    # proc arms need a PICKLABLE init spec (cluster/procs.py); the
+    # thread arms keep the historical jax init so the pre-existing
+    # curve stays comparable round over round
+    proc_init = {"kind": "hashed_uniform", "scale": 0.1, "seed": seed}
+    init = (
+        None if shard_procs else ranged_random_factor(seed + 1, (dim,))
+    )
 
     arms = []
     for n_shards in shard_counts:
@@ -105,6 +112,8 @@ def run_cluster_bench(
                 staleness_bound=staleness_bound,
                 window=window,
                 chunk=chunk,
+                shard_procs=shard_procs,
+                proc_init=proc_init if shard_procs else None,
             ),
             registry=reg,
         )
@@ -150,6 +159,8 @@ def run_cluster_bench(
         "staleness_bound": staleness_bound,
         "window": window,
         "chunk": chunk,
+        "shard_procs": shard_procs,
+        "cpus": os.cpu_count(),
         "platform": jax.default_backend(),
     }
 
@@ -168,50 +179,90 @@ def main():
         os.execve(sys.executable, [sys.executable, *sys.argv], env)
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rounds", type=int, default=30)
+    ap.add_argument("--rounds", type=int, default=20)
     ap.add_argument("--batch", type=int, default=2_048)
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--num-items", type=int, default=8_192)
     ap.add_argument("--dim", type=int, default=16)
     ap.add_argument("--bound", type=int, default=0)
+    ap.add_argument("--threads-only", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    r = run_cluster_bench(
+    common = dict(
         rounds=args.rounds, batch=args.batch, num_workers=args.workers,
         num_items=args.num_items, dim=args.dim,
         staleness_bound=args.bound,
     )
-    best = max(a["updates_per_sec"] for a in r["arms"])
+    threads = run_cluster_bench(shard_procs=False, **common)
+    procs = (
+        None if args.threads_only
+        else run_cluster_bench(shard_procs=True, **common)
+    )
+
+    def ratio(i):
+        if procs is None:
+            return None
+        t = threads["arms"][i]["updates_per_sec"]
+        p = procs["arms"][i]["updates_per_sec"]
+        return round(p / t, 2) if t else None
+
+    headline = (procs or threads)["arms"]
+    best = max(a["updates_per_sec"] for a in headline)
     payload = {
+        # the canonical ledger metric name (bench.py emits the same):
+        # renaming it would orphan the r01..r05 history in
+        # tools/bench_history.py — the best arm is now the proc sweep's
         "metric": "cluster scaling (multi-shard PS, online MF)",
         "value": best,
         "unit": "updates/sec (best arm)",
-        "extra": r,
+        "extra": {
+            "threads": threads,
+            "procs": procs,
+            "proc_over_thread": (
+                [ratio(i) for i in range(len(threads["arms"]))]
+                if procs else None
+            ),
+        },
     }
     print(json.dumps(payload))
 
     out = args.out or os.path.join(
-        REPO, "results", r["platform"], "cluster_scaling.md"
+        REPO, "results", threads["platform"], "cluster_scaling.md"
     )
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    cpus = threads["cpus"]
     lines = [
-        f"# cluster scaling (1/2/4 shards) — {r['platform']}, {stamp}",
-        f"# items={r['num_items']} dim={r['dim']} batch={r['batch']} "
-        f"rounds={r['rounds']} workers={r['num_workers']} "
-        f"bound={r['staleness_bound']} window={r['window']}",
-        "# thread-backed shards on ONE host: arms share cores — see",
-        "# docs/perf_status.md for which claims this artifact backs",
+        f"# cluster scaling (1/2/4 shards) — {threads['platform']}, "
+        f"{stamp}",
+        f"# items={threads['num_items']} dim={threads['dim']} "
+        f"batch={threads['batch']} rounds={threads['rounds']} "
+        f"workers={threads['num_workers']} "
+        f"bound={threads['staleness_bound']} window={threads['window']} "
+        f"cpus={cpus}",
+        "# thread shards share ONE GIL (the flat-to-inverted curve); "
+        "proc shards",
+        "# (cluster/procs.py, binary transport) are the GIL escape — "
+        "on a host with",
+        "# cores >= shards the proc curve rises; on this "
+        f"{cpus}-CPU container the",
+        "# processes time-share one core, so the honest evidence is "
+        "the per-arm",
+        "# proc/thread ratio and the collapse -> gentle-slope shape "
+        "change.",
         "",
-        "| shards | updates/sec | pull p50 ms | pull p99 ms | frames |"
-        " blocks |",
+        "| shards | threads upd/s | procs upd/s | procs/threads | "
+        "threads p99 ms | procs p99 ms |",
         "|---|---|---|---|---|---|",
     ]
-    for a in r["arms"]:
+    for i, a in enumerate(threads["arms"]):
+        p = procs["arms"][i] if procs else None
         lines.append(
             f"| {a['num_shards']} | {a['updates_per_sec']} "
-            f"| {a['pull_p50_ms']} | {a['pull_p99_ms']} "
-            f"| {a['pull_frames']} | {sum(a['block_counts'])} |"
+            f"| {p['updates_per_sec'] if p else '-'} "
+            f"| {ratio(i) if p else '-'} "
+            f"| {a['pull_p99_ms']} "
+            f"| {p['pull_p99_ms'] if p else '-'} |"
         )
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "w") as f:

@@ -51,6 +51,8 @@ def run_budget_bench(
     dim: int = 16,
     seed: int = 0,
     wal_dir: Optional[str] = None,
+    wire_proto: str = "auto",
+    wire_format: str = "b64",
 ) -> dict:
     """One profiled cluster run; returns the budget + oracle verdict.
     Import-time side-effect free — tests call this with tiny shapes.
@@ -84,6 +86,7 @@ def run_budget_bench(
     cfg = ClusterConfig(
         num_shards=num_shards, num_workers=1, staleness_bound=0,
         trace=True, profile=True, wal_dir=wal_dir,
+        wire_proto=wire_proto, wire_format=wire_format,
     )
     driver = ClusterDriver(
         logic, capacity=num_items, value_shape=(dim,),
@@ -129,6 +132,8 @@ def run_budget_bench(
         "rounds": rounds,
         "batch": batch,
         "num_shards": num_shards,
+        "wire_proto": wire_proto,
+        "wire_format": wire_format,
     }
 
 

@@ -18,6 +18,7 @@ from .partition import (
     Partitioner,
     RangePartitioner,
 )
+from .procs import RemoteShardStub, ShardProcess, ShardProcSpec
 from .shard import (
     FollowerLagging,
     FrozenKeys,
@@ -40,7 +41,10 @@ __all__ = [
     "ParamShard",
     "Partitioner",
     "RangePartitioner",
+    "RemoteShardStub",
     "ShardConnection",
+    "ShardProcSpec",
+    "ShardProcess",
     "ShardCrashed",
     "ShardServer",
     "StaleEpoch",

@@ -20,10 +20,26 @@ Three bandwidth levers from the reference's sender stack
     (``component=cluster``) — the same observability the event API's
     pull limiter got (:func:`~..core.api.add_pull_limiter`).
 
-Shards are contacted concurrently (one lightweight thread per shard
-per batch call): a pull's wall time is the SLOWEST shard's round trip,
-not the sum — which is what makes the 1→2→4-shard scaling benchmark
+Shards are contacted concurrently (persistent fan-out pool workers —
+:class:`_FanoutPool`; nothing is spawned per batch): a pull's wall
+time is the SLOWEST shard's round trip, not the sum — which is what
+makes the 1→2→4-shard scaling benchmark
 (``benchmarks/cluster_scaling.py``) a real scaling measurement.
+
+Binary framing (``wire_proto="auto"``, the default — docs/cluster.md
+"Binary framing"): each connection opens with the ``hello bin v=1``
+handshake; against a binary-capable server the data plane then moves
+raw ``<i8`` ids and raw fp32 (or opt-in bf16, ``wire_format="bf16"``)
+rows in length-prefixed frames — no base64, no ``repr()`` — while an
+old server's ``err bad-request`` leaves that connection on the line
+protocol (``wire_proto="line"`` never negotiates: the compat
+baseline).  Epoch fencing, ``pr=`` priority, ``pid=`` exactly-once
+tokens, ``sess=`` lease sessions, ``t=`` trace tokens, and ``inv=``
+piggybacks all ride the frames (header fields + TLVs); rejection
+handling is framing-agnostic.  ``spawn_grace_s`` bounds a dial-retry
+window for REFUSED connects — a just-(re)spawned shard process
+(cluster/procs.py) racing its own bind is liveness, not the
+conn-class failure the retry budget exists for.
 
 Pull RTT lands in a ``cluster_pull_rtt_seconds`` histogram per client
 (p99 is the benchmark's tail-latency column).
@@ -110,9 +126,10 @@ import numpy as np
 from ..core.api import ParameterServerClient
 from ..loadgen.overload import OverloadedError, RetryBudgetExhausted
 from ..ops.dedup import aggregate_deltas, coalesce_ids
-from ..telemetry.distributed import TraceContext, format_token, new_trace
+from ..telemetry.distributed import TraceContext, new_trace
 from ..telemetry.profiler import NULL_PROFILER, resolve_profiler
 from ..telemetry.spans import gen_id
+from ..utils import frames as binf
 from ..utils.net import (
     PeerHalfClosed,
     _safe_verb,
@@ -126,10 +143,23 @@ _NULL_CM = contextlib.nullcontext()
 
 
 class ShardConnection:
-    """One pipelined line-protocol connection to one shard.
+    """One pipelined connection to one shard — line protocol, binary
+    frames (utils/frames.py), or both mixed.
 
-    ``request_many`` keeps up to ``window`` frames outstanding; the
+    ``request_many`` keeps up to ``window`` requests outstanding; the
     shard answers in order, so responses re-associate positionally.
+    Each request is self-describing: a ``str`` goes out as a text line
+    (answered by a text line), ``bytes`` as a binary frame (answered
+    by a binary frame decoded into a :class:`~..utils.frames.Frame`) —
+    which is what lets the data plane go binary while control verbs
+    (``stats``/``flush``) stay greppable text on the SAME connection.
+
+    ``negotiate=True`` sends the ``hello bin v=1`` handshake at dial
+    time; :attr:`proto` is then ``"bin"`` against a binary-capable
+    server and ``"line"`` against an old one (which answered ``err
+    bad-request`` — the downgrade path, docs/cluster.md).  Callers
+    must not send binary frames on a ``"line"`` connection.
+
     Not thread-safe — each worker owns its connections (the driver
     builds one client per worker).
     """
@@ -142,6 +172,7 @@ class ShardConnection:
         window: int = 8,
         timeout: float = 30.0,
         connect_timeout: Optional[float] = None,
+        negotiate: bool = False,
     ):
         # dial and read deadlines are separate levers (failover-grade
         # failure detection needs a tight dial even when reads may
@@ -166,32 +197,83 @@ class ShardConnection:
         self._rfile = self._sock.makefile("rb")
         self.inflight = 0
         self.requests_sent = 0
+        self.proto = "line"
         # client-role wire ledger (utils/net.py): bytes/frames per
         # verb, each direction — the other endpoint of the shard
         # servers' accounting
         self._meter = client_meter()
+        if negotiate:
+            self._negotiate()
 
-    def request_many(self, lines: Sequence[str]) -> List[str]:
-        """Pipelined request/response: send up to ``window`` frames
-        ahead of the reads, return one response line per request."""
-        out: List[str] = []
+    def _negotiate(self) -> None:
+        """The per-connection binary handshake: one text round trip at
+        dial time.  ``ok proto=bin`` upgrades; anything else (an old
+        server's ``err bad-request``) leaves the connection on the
+        line protocol — never an error."""
+        resp = self.request_many([binf.HELLO_LINE])[0]
+        if isinstance(resp, str) and resp.startswith("ok proto=bin"):
+            self.proto = "bin"
+
+    def _read_exact(self, n: int, what: str) -> bytes:
+        """Exactly ``n`` bytes off the buffered reader, or
+        :class:`PeerHalfClosed` — a short read at EOF is the binary
+        twin of the torn line frame (the peer died mid-frame)."""
+        data = self._rfile.read(n)
+        if data is None:
+            data = b""
+        if len(data) != n:
+            count_half_closed("client")
+            raise PeerHalfClosed(
+                f"shard {self.host}:{self.port} closed mid-{what} "
+                f"({len(data)}/{n} bytes)"
+            )
+        return data
+
+    def _read_bin_response(self):
+        hdr = self._read_exact(binf.HEADER_SIZE, "frame header")
+        total = binf.frame_length(hdr)
+        body = self._read_exact(total - binf.HEADER_SIZE, "frame body")
+        # decode_split keeps header and body separate — joining them
+        # would copy the whole row payload just to view into it
+        frame = binf.decode_split(hdr, body, kind="response")
+        self._meter.count("in", frame.verb_name, total)
+        return frame
+
+    def request_many(self, lines: Sequence) -> List:
+        """Pipelined request/response: send up to ``window`` requests
+        ahead of the reads, return one response per request —
+        positionally, ``str`` for text lines, decoded
+        :class:`~..utils.frames.Frame` for binary frames."""
+        out: List = []
         pending = 0
-        pending_verbs: List[str] = []
+        pending_meta: List[Tuple[str, str]] = []  # (framing, verb)
         it = iter(lines)
         sent = 0
         total = len(lines)
         while sent < total or pending:
             while pending < self.window and sent < total:
-                line = next(it)
-                data = line.encode("utf-8") + b"\n"
+                req = next(it)
+                if isinstance(req, (bytes, bytearray, memoryview)):
+                    data = bytes(req)
+                    verb = binf.peek_verb_name(data)
+                    framing = "bin"
+                else:
+                    data = req.encode("utf-8") + b"\n"
+                    verb = _safe_verb(req)
+                    framing = "line"
                 self._sock.sendall(data)
-                verb = _safe_verb(line)
                 self._meter.count("out", verb, len(data))
-                pending_verbs.append(verb)
+                pending_meta.append((framing, verb))
                 pending += 1
                 sent += 1
                 self.inflight = pending
                 self.requests_sent += 1
+            framing, verb = pending_meta.pop(0)
+            if framing == "bin":
+                out.append(self._read_bin_response())
+                pending -= 1
+                self.inflight = pending
+                continue
             raw = self._rfile.readline()
             if not raw or not raw.endswith(b"\n"):
                 # empty read = peer half-close: the shard is GONE (died,
@@ -211,7 +293,7 @@ class ShardConnection:
                     f"({len(out)}/{total} responses"
                     + (", torn frame" if raw else "") + ")"
                 )
-            self._meter.count("in", pending_verbs.pop(0), len(raw))
+            self._meter.count("in", verb, len(raw))
             out.append(raw.decode("utf-8", "replace").rstrip("\n"))
             pending -= 1
             self.inflight = pending
@@ -239,38 +321,86 @@ class ShardConnection:
             pass
 
 
-def _check_ok(resp: str, what: str) -> str:
+def _frame_status(resp) -> Optional[int]:
+    """The binary status code of a response, or None for text lines —
+    the one switch every classifier below branches on, so each check
+    reads identically over both framings."""
+    return resp.flag if isinstance(resp, binf.Frame) else None
+
+
+def _describe(resp) -> str:
+    if isinstance(resp, binf.Frame):
+        detail = resp.tlv_str(binf.T_ERR) or ""
+        return f"err {resp.status_name}" + (f": {detail}" if detail else "")
+    return resp
+
+
+def _check_ok(resp, what: str):
+    status = _frame_status(resp)
+    if status is not None:
+        if status != binf.STATUS_OK:
+            raise RuntimeError(f"{what} failed: {_describe(resp)}")
+        return resp
     if not resp.startswith("ok"):
         raise RuntimeError(f"{what} failed: {resp}")
     return resp
 
 
-def _is_reject(resp: str) -> bool:
+def _is_reject(resp) -> bool:
     """A shard answer the elastic client treats as retry-after-refresh
     rather than an error: the map flipped (stale-epoch) or the keys are
     mid-migration (frozen)."""
+    status = _frame_status(resp)
+    if status is not None:
+        return status in (binf.STATUS_STALE_EPOCH, binf.STATUS_FROZEN)
     return resp.startswith("err stale-epoch") or resp.startswith(
         "err frozen"
     )
 
 
-def _is_overloaded(resp: str) -> bool:
+def _reject_reason(resp) -> str:
+    status = _frame_status(resp)
+    if status is not None:
+        return (
+            "frozen" if status == binf.STATUS_FROZEN else "stale-epoch"
+        )
+    return (
+        "frozen" if resp.startswith("err frozen") else "stale-epoch"
+    )
+
+
+def _is_overloaded(resp) -> bool:
     """The shard's typed shed answer (loadgen/overload.py
     ``OverloadGuard``): the request was REJECTED under load pressure,
     deliberately and cheaply.  The client fails fast with
     :class:`~..loadgen.overload.OverloadedError` — retrying a shed
     would feed exactly the storm the shed exists to stop."""
+    status = _frame_status(resp)
+    if status is not None:
+        return status == binf.STATUS_OVERLOADED
     return resp.startswith("err overloaded")
 
 
-def _is_follower_reject(resp: str) -> bool:
+def _is_follower_reject(resp) -> bool:
     """A replica-chain follower declining a read: lagging past the
     staleness bound, or no longer a follower at all.  The client falls
     back to the primary — NOT a membership refresh (the map is fine;
     this one replica is stale)."""
+    status = _frame_status(resp)
+    if status is not None:
+        return status in (
+            binf.STATUS_LAGGING, binf.STATUS_NOT_PRIMARY
+        )
     return resp.startswith("err lagging") or resp.startswith(
         "err not-primary"
     )
+
+
+def _is_bad_request(resp) -> bool:
+    status = _frame_status(resp)
+    if status is not None:
+        return status == binf.STATUS_BAD_REQUEST
+    return resp.startswith("err bad-request")
 
 
 class _Rejected(Exception):
@@ -290,6 +420,104 @@ class _LeaseUnsupported(Exception):
     ``err bad-request`` — a pre-hotcache server.  The client downgrades
     to plain pulls for the rest of its life (the PR-6 versioning
     contract working in the other direction)."""
+
+
+class _PoolWorker:
+    """One persistent fan-out thread (see :class:`_FanoutPool`).
+    Job hand-off state is guarded by ``_lock`` (the condition shares
+    it — the :class:`~..replication.shipper._FollowerQueue` idiom)."""
+
+    def __init__(self, name: str):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._job = None
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn, errors, errors_lock) -> threading.Event:
+        done = threading.Event()
+        with self._lock:
+            self._job = (fn, errors, errors_lock, done)
+            self._cond.notify()
+        return done
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._job is None and not self._stopped:
+                    self._cond.wait(0.2)
+                if self._stopped:
+                    return
+                fn, errors, errors_lock, done = self._job
+                self._job = None
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised by run()
+                with errors_lock:
+                    errors.append(e)
+            finally:
+                done.set()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._cond.notify()
+        self._thread.join(timeout=5)
+
+
+class _FanoutPool:
+    """Persistent threads for the client's per-shard fan-out.
+
+    The batch surface used to SPAWN a fresh thread per contacted shard
+    per ``pull_batch``/``push_batch`` call — ~100 µs of create/start
+    per shard per round, paid thousands of times a second, plus a cold
+    scheduler wakeup right on the latency path.  A client makes the
+    same-shaped fan-out call every round of its life, so the threads
+    are now long-lived: one fan-out runs ``len(jobs)-1`` jobs on pool
+    workers and the LAST one inline on the calling thread (on a busy
+    host that is one fewer handoff on the critical path).  Not
+    thread-safe — owned by one client, which is itself single-caller
+    by contract."""
+
+    def __init__(self, name: str = "fps-fanout"):
+        self._name = name
+        self._workers: List[_PoolWorker] = []
+
+    def run(self, jobs) -> None:
+        if not jobs:
+            return
+        if len(jobs) == 1:
+            jobs[0]()
+            return
+        errors: List[BaseException] = []
+        lock = threading.Lock()
+        while len(self._workers) < len(jobs) - 1:
+            self._workers.append(_PoolWorker(
+                f"{self._name}-{len(self._workers)}"
+            ))
+        waits = [
+            w.submit(fn, errors, lock)
+            for w, fn in zip(self._workers, jobs[:-1])
+        ]
+        try:
+            jobs[-1]()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            with lock:
+                errors.append(e)
+        for done in waits:
+            done.wait()
+        if errors:
+            raise errors[0]
+
+    def close(self) -> None:
+        """Join every worker — a closed client must leak no package
+        threads (the nemesis ThreadLedger invariant)."""
+        for w in self._workers:
+            w.stop()
+        self._workers = []
 
 
 class ClusterClient(ParameterServerClient):
@@ -314,6 +542,8 @@ class ClusterClient(ParameterServerClient):
         timeout: float = 30.0,
         connect_timeout: float = 5.0,
         wire_format: str = "b64",
+        wire_proto: str = "auto",
+        spawn_grace_s: float = 0.0,
         registry=None,
         worker: Optional[str] = None,
         membership=None,
@@ -361,15 +591,35 @@ class ClusterClient(ParameterServerClient):
             self._replicas = [tuple(r) for r in view.replicas]
         if chunk < 1:
             raise ValueError(f"chunk={chunk}: must be >= 1")
-        if wire_format not in ("text", "b64"):
-            raise ValueError(f"wire_format={wire_format!r}: 'text' | 'b64'")
+        if wire_format not in ("text", "b64", "bf16"):
+            raise ValueError(
+                f"wire_format={wire_format!r}: 'text' | 'b64' | 'bf16'"
+            )
+        if wire_proto not in ("auto", "line"):
+            raise ValueError(
+                f"wire_proto={wire_proto!r}: 'auto' | 'line'"
+            )
         self.membership = membership
         self.hedge = hedge
         self.value_shape = tuple(int(s) for s in value_shape)
         self.chunk = int(chunk)
         # b64 (default): exact fp32 bytes, ~100x cheaper than per-float
-        # text (shard.py module docstring); "text" for debuggability
+        # text (shard.py module docstring); "text" for debuggability.
+        # Over the binary framing, "text"/"b64" both become raw fp32
+        # (exact); "bf16" halves row bytes (lossy, opt-in — falls back
+        # to b64 on a line-proto connection, which has no bf16).
         self.wire_format = wire_format
+        # "auto": negotiate binary framing per connection (one hello
+        # round trip at dial time; an old server's err bad-request
+        # downgrades that connection to the line protocol).  "line":
+        # never negotiate — bit-for-bit the pre-binary client, the
+        # compat baseline the cross-version tests pin.
+        self._wire_proto = wire_proto
+        # spawn grace (cluster/procs.py): a just-spawned shard process
+        # may not have bound yet when its first dial arrives — retry
+        # REFUSED dials inside this window instead of surfacing a
+        # conn-class reject that burns storm retry budget
+        self._spawn_grace_s = float(spawn_grace_s)
         self._window = int(window)
         self._timeout = float(timeout)
         self._connect_timeout = float(connect_timeout)
@@ -403,6 +653,10 @@ class ClusterClient(ParameterServerClient):
         )
         self._last_retry_sleep: Optional[float] = None
         self._conns: Dict[Tuple[str, int], ShardConnection] = {}
+        # persistent per-shard fan-out threads (no per-batch spawns)
+        self._pool = _FanoutPool(
+            f"fps-fanout-{worker}" if worker is not None else "fps-fanout"
+        )
         self.outputs: List[object] = []
         self._pending_pulls: List[int] = []
         self._pending_pushes: List[Tuple[int, np.ndarray]] = []
@@ -517,11 +771,18 @@ class ClusterClient(ParameterServerClient):
         self._sess = f"c{self._pid_base}"
         return self
 
-    def _apply_response_options(self, resp: str) -> str:
-        """Strip trailing response options (``inv=`` piggybacks) and
-        apply them to the cache; returns the bare response line."""
+    def _apply_response_options(self, resp):
+        """Apply piggybacked response options (``inv=`` invalidations)
+        to the cache.  Text lines are stripped of their trailing
+        tokens and returned bare; binary frames carry the same payload
+        in a ``T_INV`` TLV and are returned as-is."""
         from ..hotcache.leases import parse_inv_token, split_response_options
 
+        if isinstance(resp, binf.Frame):
+            inv = resp.tlv_str(binf.T_INV)
+            if inv is not None and self.hotcache is not None:
+                self.hotcache.invalidate(parse_inv_token(inv))
+            return resp
         body, opts = split_response_options(resp)
         inv = opts.get("inv")
         if inv is not None and self.hotcache is not None:
@@ -535,14 +796,35 @@ class ClusterClient(ParameterServerClient):
         return sum(c.inflight for c in list(self._conns.values()))
 
     # -- connections / membership -------------------------------------------
+    def _dial(self, addr: Tuple[str, int]) -> ShardConnection:
+        """Dial one shard (negotiating the binary framing when
+        ``wire_proto="auto"``).  A REFUSED dial inside the spawn grace
+        window is retried with short sleeps: a shard process that was
+        just spawned (or respawned by its supervisor) races its own
+        ``bind`` against the first dial, and that race is liveness —
+        not the conn-class failure signal the retry budget and the
+        breaker exist for."""
+        deadline = (
+            time.monotonic() + self._spawn_grace_s
+            if self._spawn_grace_s > 0 else None
+        )
+        while True:
+            try:
+                return ShardConnection(
+                    addr[0], addr[1], window=self._window,
+                    timeout=self._timeout,
+                    connect_timeout=self._connect_timeout,
+                    negotiate=self._wire_proto == "auto",
+                )
+            except ConnectionRefusedError:
+                if deadline is None or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+
     def _conn_for_addr(self, addr: Tuple[str, int]) -> ShardConnection:
         conn = self._conns.get(addr)
         if conn is None:
-            conn = ShardConnection(
-                addr[0], addr[1], window=self._window,
-                timeout=self._timeout,
-                connect_timeout=self._connect_timeout,
-            )
+            conn = self._dial(addr)
             self._conns[addr] = conn
         return conn
 
@@ -905,6 +1187,7 @@ class ClusterClient(ParameterServerClient):
         for c in list(self._conns.values()):
             c.close()
         self._conns = {}
+        self._pool.close()
         if self.hedge is not None:
             self.hedge.close()
 
@@ -916,30 +1199,17 @@ class ClusterClient(ParameterServerClient):
         }
 
     def _for_each_shard(self, by_shard: Dict[int, np.ndarray], fn) -> None:
-        """Run ``fn(shard, ids)`` for every shard concurrently (one
-        thread per contacted shard; errors propagate to the caller)."""
+        """Run ``fn(shard, ids)`` for every shard concurrently —
+        persistent pool workers for all but one, the last inline on
+        this thread (errors propagate to the caller; see
+        :class:`_FanoutPool` for why nothing is spawned here)."""
         items = list(by_shard.items())
         if len(items) == 1:
             fn(*items[0])
             return
-        errors: List[BaseException] = []
-
-        def run(s, sids):
-            try:
-                fn(s, sids)
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
-
-        threads = [
-            threading.Thread(target=run, args=(s, sids), daemon=True)
-            for s, sids in items
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+        self._pool.run([
+            (lambda s=s, sids=sids: fn(s, sids)) for s, sids in items
+        ])
 
     def _frame_suffix(self, pid: Optional[str] = None) -> str:
         suffix = ""
@@ -959,22 +1229,35 @@ class ClusterClient(ParameterServerClient):
         return suffix
 
     def _frame_trace(self, shard: int, name: str, ctx):
-        """Per-shard child span + the wire token its id rides on:
-        ``(token_suffix, span_cm, span_id)`` — empties when untraced."""
+        """Per-shard child span + the BARE trace token its id rides on
+        (``<trace>:<span>`` — the line protocol prefixes ``t=``, the
+        binary framing carries it as a ``T_TRACE`` TLV):
+        ``(token_or_None, span_cm, span_id)`` — empties when
+        untraced."""
         if ctx is None or self._tracer is None or not self._tracer.enabled:
-            return "", _NULL_CM, None
+            return None, _NULL_CM, None
         span_id = gen_id(4)
-        tok = " " + format_token(TraceContext(ctx.trace_id, span_id))
+        tok = TraceContext(ctx.trace_id, span_id).token()
         cm = self._tracer.span(
             f"{name}.shard{shard}", "cluster",
             trace_id=ctx.trace_id, parent_id=ctx.span_id, span_id=span_id,
         )
         return tok, cm, span_id
 
+    @staticmethod
+    def _materialize(lines, conn) -> List:
+        """Requests for one connection: a plain list is used as-is; a
+        CALLABLE is invoked with the connection (``build(conn)``) so
+        the emit paths can render text lines or binary frames per the
+        connection's negotiated protocol — which may differ between a
+        replica and the primary it falls back to (a mixed-version
+        fleet mid-rollout)."""
+        return lines(conn) if callable(lines) else lines
+
     def _request_frames(
-        self, shard: int, sids: np.ndarray, lines: List[str], *,
+        self, shard: int, sids: np.ndarray, lines, *,
         hedgeable: bool, trace=None,
-    ) -> List[str]:
+    ) -> List:
         """Send one shard's frames; a connection-level failure in
         elastic mode becomes a :class:`_Rejected` (drop the cached
         connection, let the batch loop refresh + replay) instead of an
@@ -987,6 +1270,7 @@ class ClusterClient(ParameterServerClient):
             raise _Rejected(sids, "breaker_open")
         try:
             conn = self._conn_for(shard)
+            reqs = self._materialize(lines, conn)
             if hedgeable and self.hedge is not None:
                 addr = self._addresses[shard]
 
@@ -1001,17 +1285,13 @@ class ClusterClient(ParameterServerClient):
 
                 resps = self.hedge.request_many(
                     conn,
-                    lambda: ShardConnection(
-                        addr[0], addr[1], window=self._window,
-                        timeout=self._timeout,
-                        connect_timeout=self._connect_timeout,
-                    ),
-                    lines,
+                    lambda: self._dial(addr),
+                    reqs,
                     on_backup_won,
                     trace=trace,
                 )
             else:
-                resps = conn.request_many(lines)
+                resps = conn.request_many(reqs)
         except OSError:
             # transport failure feeds the breaker (a dead/wedged shard
             # opens its circuit after enough of these in the window)
@@ -1026,9 +1306,8 @@ class ClusterClient(ParameterServerClient):
         return resps
 
     def _read_frames(
-        self, shard: int, sids: np.ndarray, lines: List[str], *,
-        trace=None,
-    ) -> List[str]:
+        self, shard: int, sids: np.ndarray, lines, *, trace=None,
+    ) -> List:
         """Route one shard's READ frames: a replica when the rotation
         picks one, the primary otherwise — and always the primary as
         the fallback when the replica declines (lagging/not-primary)
@@ -1048,7 +1327,7 @@ class ClusterClient(ParameterServerClient):
             _is_follower_reject(r) for r in resps
         ):
             if self._c_replica_reads is not None:
-                self._c_replica_reads.inc(len(lines))
+                self._c_replica_reads.inc(len(resps))
             return resps
         if self._c_fallbacks is not None:
             self._c_fallbacks.inc()
@@ -1057,15 +1336,16 @@ class ClusterClient(ParameterServerClient):
         )
 
     def _replica_request(
-        self, shard: int, addr: Tuple[str, int], lines: List[str], trace
-    ) -> List[str]:
+        self, shard: int, addr: Tuple[str, int], lines, trace
+    ) -> List:
         """One replica's frames — hedged, when a hedger is attached,
         against the PRIMARY: a straggling replica races the shard's
         write owner and the first answer wins (the budgeted
         elastic/hedging.py race, re-aimed across the chain)."""
         conn = self._conn_for_addr(addr)
+        reqs = self._materialize(lines, conn)
         if self.hedge is None:
-            return conn.request_many(lines)
+            return conn.request_many(reqs)
         primary = self._addresses[shard]
 
         def on_backup_won(spare_conn):
@@ -1079,12 +1359,8 @@ class ClusterClient(ParameterServerClient):
 
         return self.hedge.request_many(
             conn,
-            lambda: ShardConnection(
-                primary[0], primary[1], window=self._window,
-                timeout=self._timeout,
-                connect_timeout=self._connect_timeout,
-            ),
-            lines,
+            lambda: self._dial(primary),
+            reqs,
             on_backup_won,
             trace=trace,
         )
@@ -1149,29 +1425,55 @@ class ClusterClient(ParameterServerClient):
             for i in range(0, len(cold_ids), self.chunk)
         ]
         tok, span_cm, _span_id = self._frame_trace(shard, "lease", ctx)
-        suffix = self._frame_suffix() + tok
-        enc = " b64" if self.wire_format == "b64" else " text"
         all_ids = np.concatenate([hot_ids, cold_ids])
         hot_rows: List[np.ndarray] = []
         cold_rows: List[np.ndarray] = []
         rejected = False
         reject_reason = "reject"
-        with span_cm:
-            lines = [
+
+        def build(conn) -> List:
+            if conn.proto == "bin":
+                enc = self._bin_enc()
+                tlvs = self._bin_tlvs(tok)
+                lease_tlvs = [
+                    (binf.T_TTL, str(self._lease_ttl).encode())
+                ] + tlvs
+                return [
+                    binf.encode_request(
+                        binf.VERB_IDS["lease"], ids=c, enc=enc,
+                        epoch=self._epoch, priority=self._priority,
+                        tlvs=lease_tlvs,
+                    )
+                    for c in hot_chunks
+                ] + [
+                    binf.encode_request(
+                        binf.VERB_IDS["pull"], ids=c, enc=enc,
+                        epoch=self._epoch, priority=self._priority,
+                        tlvs=tlvs,
+                    )
+                    for c in cold_chunks
+                ]
+            suffix = self._frame_suffix() + (
+                " t=" + tok if tok is not None else ""
+            )
+            enc_tok = " text" if self.wire_format == "text" else " b64"
+            return [
                 "lease " + ",".join(str(int(i)) for i in c)
-                + enc + f" ttl={self._lease_ttl}" + suffix
+                + enc_tok + f" ttl={self._lease_ttl}" + suffix
                 for c in hot_chunks
             ] + [
                 "pull " + ",".join(str(int(i)) for i in c)
-                + enc + suffix
+                + enc_tok + suffix
                 for c in cold_chunks
             ]
+
+        with span_cm:
             t0 = time.perf_counter()
             resps = self._request_frames(
-                shard, all_ids, lines, hedgeable=False
+                shard, all_ids, build, hedgeable=False
             )
-            per = (time.perf_counter() - t0) / max(1, len(lines))
-            for _ in lines:
+            per = (time.perf_counter() - t0) / max(1, len(resps))
+            for _ in resps:
                 if self._h_rtt is not None:
                     self._h_rtt.observe(per)
                 prof.observe("pull", "rtt", per)
@@ -1186,41 +1488,38 @@ class ClusterClient(ParameterServerClient):
                         self.breakers.fail(shard)
                     raise OverloadedError(
                         f"{'lease' if is_lease else 'pull'} shard "
-                        f"{shard}: {resp}"
+                        f"{shard}: {_describe(resp)}"
                     )
                 if _is_reject(resp) and self.membership is not None:
                     rejected = True
-                    reject_reason = (
-                        "frozen" if resp.startswith("err frozen")
-                        else "stale-epoch"
-                    )
+                    reject_reason = _reject_reason(resp)
                     continue
-                if is_lease and resp.startswith("err bad-request"):
-                    raise _LeaseUnsupported(resp)
+                if is_lease and _is_bad_request(resp):
+                    raise _LeaseUnsupported(_describe(resp))
                 _check_ok(
                     resp,
                     f"{'lease' if is_lease else 'pull'} shard {shard}",
                 )
-                if is_lease:
-                    # ok n=<k> seq=<q> ttl=<r> <payload>
+                if isinstance(resp, binf.Frame) or not is_lease:
+                    vals = self._parse_rows_any(
+                        resp, c, shard,
+                        "lease" if is_lease else "pull",
+                    )
+                else:
+                    # text lease answer: ok n=<k> seq=<q> ttl=<r> <body>
                     parts = resp.split(" ", 4)
                     if len(parts) < 5:
                         raise RuntimeError(
                             f"shard {shard} lease answer malformed: "
                             f"{resp!r}"
                         )
-                    body = parts[4]
-                else:
-                    # ok n=<k> <payload>
-                    _, _, body = resp.partition(" ")
-                    _, _, body = body.partition(" ")
-                with prof.timer("pull", "client_parse"):
-                    vals = parse_rows(body, self.value_shape)
-                if len(vals) != len(c):
-                    raise RuntimeError(
-                        f"shard {shard} answered {len(vals)} rows for "
-                        f"{len(c)} ids"
-                    )
+                    with prof.timer("pull", "client_parse"):
+                        vals = parse_rows(parts[4], self.value_shape)
+                    if len(vals) != len(c):
+                        raise RuntimeError(
+                            f"shard {shard} answered {len(vals)} rows "
+                            f"for {len(c)} ids"
+                        )
                 if is_lease:
                     self.hotcache.fill(c, vals)
                     self.leases_acquired += len(c)
@@ -1237,6 +1536,46 @@ class ClusterClient(ParameterServerClient):
         )
         return hot_out, cold_out
 
+    def _bin_enc(self) -> int:
+        """Row encoding for binary frames: exact fp32 unless the
+        client opted into bf16 (half the row bytes, lossy)."""
+        return (
+            binf.ENC_BF16 if self.wire_format == "bf16"
+            else binf.ENC_F32
+        )
+
+    def _bin_tlvs(self, tok: Optional[str], pid: Optional[str] = None):
+        """The frame TLVs mirroring :meth:`_frame_suffix`'s trailing
+        tokens (epoch and priority live in the fixed header)."""
+        tlvs = []
+        if tok is not None:
+            tlvs.append((binf.T_TRACE, tok.encode()))
+        if pid is not None:
+            tlvs.append((binf.T_PID, pid.encode()))
+        if self.hotcache is not None and self._sess is not None:
+            tlvs.append((binf.T_SESS, self._sess.encode()))
+        return tlvs
+
+    def _parse_rows_any(self, resp, chunk, shard: int, what: str):
+        """One response's rows, either framing, length-checked."""
+        prof = self._profiler
+        if isinstance(resp, binf.Frame):
+            with prof.timer("pull", "client_parse"):
+                vals = binf.rows_from_payload(
+                    resp.payload, self.value_shape, resp.enc
+                )
+        else:
+            _, _, body = resp.partition(" ")
+            _, _, body = body.partition(" ")  # strip "n=<k>"
+            with prof.timer("pull", "client_parse"):
+                vals = parse_rows(body, self.value_shape)
+        if len(vals) != len(chunk):
+            raise RuntimeError(
+                f"shard {shard} answered {len(vals)} rows for "
+                f"{len(chunk)} ids ({what})"
+            )
+        return vals
+
     def _pull_shard_wire(
         self, shard: int, ids: np.ndarray, ctx=None
     ) -> np.ndarray:
@@ -1245,7 +1584,6 @@ class ClusterClient(ParameterServerClient):
         ]
         prof = self._profiler
         tok, span_cm, span_id = self._frame_trace(shard, "pull", ctx)
-        suffix = self._frame_suffix() + tok
         trace = (
             (self._tracer, ctx.trace_id, span_id)
             if span_id is not None else None
@@ -1253,29 +1591,58 @@ class ClusterClient(ParameterServerClient):
         rows = []
         rejected: List[np.ndarray] = []
         reject_reason = "reject"
+        ser_cell = [0.0]
+
+        def build(conn) -> List:
+            """Requests for this connection's protocol — binary frames
+            (raw i8 ids + fp32/bf16 rows, options as TLVs) on a
+            negotiated connection, text lines otherwise."""
+            t_ser = time.perf_counter()
+            if conn.proto == "bin":
+                enc = self._bin_enc()
+                tlvs = self._bin_tlvs(tok)
+                reqs = [
+                    binf.encode_request(
+                        binf.VERB_IDS["pull"], ids=c, enc=enc,
+                        epoch=self._epoch, priority=self._priority,
+                        tlvs=tlvs,
+                    )
+                    for c in chunks
+                ]
+            else:
+                suffix = self._frame_suffix() + (
+                    " t=" + tok if tok is not None else ""
+                )
+                reqs = [
+                    "pull " + ",".join(str(int(i)) for i in c)
+                    + (" text" if self.wire_format == "text" else " b64")
+                    + suffix
+                    for c in chunks
+                ]
+            ser_cell[0] = (
+                (time.perf_counter() - t_ser) / max(1, len(reqs))
+            )
+            return reqs
+
         # the pull.shard<k> span covers the WHOLE per-shard round —
         # serialize, wire round trip, response parse — which makes it
         # the independent oracle the latency-budget phases (observed
         # separately below) must sum to (tests/test_profiler.py)
         with span_cm:
-            t_ser = time.perf_counter()
-            lines = [
-                "pull " + ",".join(str(int(i)) for i in c)
-                + (" b64" if self.wire_format == "b64" else " text")
-                + suffix
-                for c in chunks
-            ]
-            ser_per = (time.perf_counter() - t_ser) / max(1, len(lines))
             t0 = time.perf_counter()
-            resps = self._read_frames(shard, ids, lines, trace=trace)
+            resps = self._read_frames(shard, ids, build, trace=trace)
             # one observation per chunk frame: the pipelined per-frame
-            # turnaround, amortised (total wall / frames)
-            per = (time.perf_counter() - t0) / max(1, len(lines))
-            for _ in lines:
+            # turnaround, amortised (total wall / frames); serialize
+            # time was measured inside the builder, net of the dial
+            per = (
+                (time.perf_counter() - t0) / max(1, len(resps))
+                - ser_cell[0]
+            )
+            for _ in resps:
                 if self._h_rtt is not None:
                     self._h_rtt.observe(per)
                 prof.observe("pull", "rtt", per)
-                prof.observe("pull", "client_serialize", ser_per)
+                prof.observe("pull", "client_serialize", ser_cell[0])
             for resp, c in zip(resps, chunks):
                 if self.hotcache is not None:
                     # piggybacked inv= tokens ride any response to a
@@ -1287,25 +1654,17 @@ class ClusterClient(ParameterServerClient):
                     # failure signal on this shard
                     if self.breakers is not None:
                         self.breakers.fail(shard)
-                    raise OverloadedError(f"pull shard {shard}: {resp}")
+                    raise OverloadedError(
+                        f"pull shard {shard}: {_describe(resp)}"
+                    )
                 if _is_reject(resp) and self.membership is not None:
                     rejected.append(c)
-                    reject_reason = (
-                        "frozen" if resp.startswith("err frozen")
-                        else "stale-epoch"
-                    )
+                    reject_reason = _reject_reason(resp)
                     continue
                 _check_ok(resp, f"pull shard {shard}")
-                _, _, body = resp.partition(" ")
-                _, _, body = body.partition(" ")  # strip "n=<k>"
-                with prof.timer("pull", "client_parse"):
-                    vals = parse_rows(body, self.value_shape)
-                if len(vals) != len(c):
-                    raise RuntimeError(
-                        f"shard {shard} answered {len(vals)} rows for "
-                        f"{len(c)} ids"
-                    )
-                rows.append(vals)
+                rows.append(
+                    self._parse_rows_any(resp, c, shard, "pull")
+                )
         if rejected:
             # partial answers cannot scatter into the output without
             # per-chunk bookkeeping; pulls are idempotent, so replay
@@ -1325,33 +1684,65 @@ class ClusterClient(ParameterServerClient):
     ) -> None:
         prof = self._profiler
         tok, span_cm, _span_id = self._frame_trace(shard, "push", ctx)
-        suffix = self._frame_suffix(pid) + tok
-        lines = []
-        chunks = []
+        chunks = [
+            ids[i: i + self.chunk]
+            for i in range(0, len(ids), self.chunk)
+        ]
+        ser_cell = [0.0]
+
+        def build(conn) -> List:
+            t_ser = time.perf_counter()
+            if conn.proto == "bin":
+                enc = self._bin_enc()
+                tlvs = self._bin_tlvs(tok, pid)
+                reqs = [
+                    binf.encode_request(
+                        binf.VERB_IDS["push"],
+                        ids=ids[i: i + self.chunk],
+                        payload=binf.rows_to_payload(
+                            deltas[i: i + self.chunk], enc
+                        ),
+                        enc=enc, epoch=self._epoch,
+                        priority=self._priority, tlvs=tlvs,
+                    )
+                    for i in range(0, len(ids), self.chunk)
+                ]
+            else:
+                suffix = self._frame_suffix(pid) + (
+                    " t=" + tok if tok is not None else ""
+                )
+                fmt = (
+                    "text" if self.wire_format == "text" else "b64"
+                )
+                reqs = [
+                    "push "
+                    + ",".join(
+                        str(int(x)) for x in ids[i: i + self.chunk]
+                    )
+                    + " "
+                    + format_rows(deltas[i: i + self.chunk], fmt)
+                    + suffix
+                    for i in range(0, len(ids), self.chunk)
+                ]
+            ser_cell[0] = (
+                (time.perf_counter() - t_ser) / max(1, len(reqs))
+            )
+            return reqs
+
         # like pull: the push.shard<k> span covers serialize + round
         # trip, the same window the push phases decompose
         with span_cm:
-            t_ser = time.perf_counter()
-            for i in range(0, len(ids), self.chunk):
-                c_ids = ids[i: i + self.chunk]
-                c_del = deltas[i: i + self.chunk]
-                chunks.append(c_ids)
-                lines.append(
-                    "push "
-                    + ",".join(str(int(x)) for x in c_ids)
-                    + " "
-                    + format_rows(c_del, self.wire_format)
-                    + suffix
-                )
-            ser_per = (time.perf_counter() - t_ser) / max(1, len(lines))
             t0 = time.perf_counter()
             resps = self._request_frames(
-                shard, ids, lines, hedgeable=False
+                shard, ids, build, hedgeable=False
             )
-            per = (time.perf_counter() - t0) / max(1, len(lines))
-            for _ in lines:
+            per = (
+                (time.perf_counter() - t0) / max(1, len(resps))
+                - ser_cell[0]
+            )
+            for _ in resps:
                 prof.observe("push", "rtt", per)
-                prof.observe("push", "client_serialize", ser_per)
+                prof.observe("push", "client_serialize", ser_cell[0])
         rejected: List[np.ndarray] = []
         reject_reason = "reject"
         for resp, c_ids in zip(resps, chunks):
@@ -1360,13 +1751,12 @@ class ClusterClient(ParameterServerClient):
             if _is_overloaded(resp):
                 if self.breakers is not None:
                     self.breakers.fail(shard)
-                raise OverloadedError(f"push shard {shard}: {resp}")
+                raise OverloadedError(
+                    f"push shard {shard}: {_describe(resp)}"
+                )
             if _is_reject(resp) and self.membership is not None:
                 rejected.append(c_ids)
-                reject_reason = (
-                    "frozen" if resp.startswith("err frozen")
-                    else "stale-epoch"
-                )
+                reject_reason = _reject_reason(resp)
                 continue
             _check_ok(resp, f"push shard {shard}")
         if rejected:

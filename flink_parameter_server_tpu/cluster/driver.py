@@ -69,10 +69,31 @@ class ClusterConfig:
     worker_key: str = "user"
     # client knobs: pipelining window (outstanding frames per shard
     # connection), ids per frame, payload encoding (shard.py: "b64"
-    # exact+fast, "text" exact+debuggable)
+    # exact+fast, "text" exact+debuggable, "bf16" half-bytes lossy —
+    # binary framing only)
     window: int = 8
     chunk: int = 512
     wire_format: str = "b64"
+    # transport framing (utils/frames.py, docs/cluster.md "Binary
+    # framing"): "auto" negotiates the length-prefixed binary frame
+    # per connection (one hello round trip; old servers answer err
+    # bad-request and the connection stays on the line protocol);
+    # "line" never negotiates — the pre-binary client, byte for byte
+    wire_proto: str = "auto"
+    # shard worker PROCESSES (cluster/procs.py): each shard server in
+    # its own spawned process — its own GIL — with the numpy store
+    # backend.  Base ClusterDriver topologies only (the elastic /
+    # replication control planes drive in-process shard handles).
+    shard_procs: bool = False
+    # deterministic picklable init for proc shards ({"kind": ...},
+    # procs.resolve_init); ignored by the in-process path, which takes
+    # init_fn callables directly
+    proc_init: Optional[dict] = None
+    # how long a client retries a REFUSED dial before treating it as a
+    # conn-class failure: a freshly (re)spawned shard process races
+    # its bind against the first dial (procs.py; the _await_retry
+    # interaction fix — dial retries here never spend retry budget)
+    spawn_grace_s: float = 3.0
     # per-shard WALs under <wal_dir>/shard-<i>; None = no durability
     wal_dir: Optional[str] = None
     supervised: bool = True  # ShardServer restart supervision
@@ -172,6 +193,19 @@ class ClusterDriver:
                 f"partition={cfg.partition!r}: 'range' | 'hash'"
             )
         self._init_fn = init_fn
+        if (
+            init_fn is None
+            and self.config.proc_init is not None
+            and not self.config.shard_procs
+        ):
+            # one init spec drives BOTH arms: proc children resolve it
+            # numpy-side, the in-process path renders the same rows
+            # through jax — the proc-vs-thread parity contract
+            from .procs import as_jax_init
+
+            self._init_fn = as_jax_init(
+                self.config.proc_init, self.value_shape
+            )
         self._rng = rng
         if registry is not False:
             from ..telemetry.registry import get_registry
@@ -209,6 +243,44 @@ class ClusterDriver:
         """One shard + its TCP front end (the elastic driver reuses
         this for scale-out spin-up and dead-shard replacement)."""
         cfg = self.config
+        if cfg.shard_procs:
+            # shard worker processes (cluster/procs.py): the GIL
+            # escape.  Only the base driver's static topology — the
+            # elastic/replication control planes operate on in-process
+            # shard handles (freeze/install_epoch/promote are
+            # deliberately wire-less, docs/cluster.md).
+            if type(self) is not ClusterDriver:
+                raise NotImplementedError(
+                    f"shard_procs=True supports the base ClusterDriver "
+                    f"only (got {type(self).__name__}: the elastic "
+                    f"control plane drives in-process shard handles)"
+                )
+            if self._init_fn is not None and cfg.proc_init is None:
+                raise ValueError(
+                    "shard_procs=True cannot pickle an arbitrary "
+                    "init_fn into the child — describe the init with "
+                    "ClusterConfig.proc_init (procs.resolve_init) "
+                    "and build the matching in-process init with "
+                    "procs.as_jax_init"
+                )
+            from .procs import (
+                RemoteShardStub,
+                ShardProcSpec,
+                ShardProcess,
+            )
+
+            proc = ShardProcess(ShardProcSpec(
+                shard_id=shard_id,
+                partition=cfg.partition,
+                capacity=self.capacity,
+                num_shards=cfg.num_shards,
+                value_shape=self.value_shape,
+                wal_dir=self._wal_dir_for(shard_id),
+                init=cfg.proc_init,
+                supervised=cfg.supervised,
+                host=cfg.host,
+            )).wait_ready()
+            return RemoteShardStub(proc), proc
         hotkeys = None
         if cfg.hot_keys:
             from ..telemetry.hotkeys import HotKeySketch, get_aggregator
@@ -283,6 +355,10 @@ class ClusterDriver:
             timeout=cfg.request_timeout,
             connect_timeout=cfg.connect_timeout,
             wire_format=cfg.wire_format,
+            wire_proto=cfg.wire_proto,
+            spawn_grace_s=(
+                cfg.spawn_grace_s if cfg.shard_procs else 0.0
+            ),
             registry=self.registry if self.registry is not None else False,
             worker=worker,
             tracer=self.client_tracer,

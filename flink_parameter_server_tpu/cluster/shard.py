@@ -8,6 +8,16 @@ serving plane (``serving/server.py``) and the ingest edge
 (``data/socket.py``) — the socket skeleton itself comes from
 :class:`~..utils.net.LineServer`.
 
+Two framings, one protocol (docs/cluster.md "Binary framing"): the
+line protocol below is the bootstrap and compat surface, and a client
+may negotiate the LENGTH-PREFIXED BINARY framing per connection with
+a first ``hello bin v=1`` line — every verb, option token, and error
+reason then maps one-for-one onto ``utils/frames.py`` frames (ids as
+raw ``<i8``, rows as raw ``<f4``/bf16 received zero-copy, options as
+TLVs, ``err <reason>`` as status bytes), dispatched by
+:meth:`ShardServer.respond_frame`.  An old server answers the hello
+with ``err bad-request`` and the connection stays on lines.
+
 Wire protocol (one request line → one response line, in order, per
 connection).  Every verb accepts trailing ``key=value`` options;
 ``e=<epoch>`` tags the frame with the client's partition-map epoch,
@@ -125,6 +135,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils import frames as binf
 from ..utils.net import LineServer
 from .partition import Partitioner
 
@@ -234,6 +245,38 @@ def parse_ids(tok: str) -> np.ndarray:
     return ids
 
 
+class _NumpyStore:
+    """A jax-free stand-in for :class:`~..core.store.ShardedParamStore`
+    with the surface :class:`ParamShard` touches (``from_values`` /
+    ``values`` / ``push``) — the store backend shard WORKER PROCESSES
+    run (cluster/procs.py): a spawned shard must not pay a jax import
+    (seconds) or a per-push XLA dispatch (~ms) for a µs scatter-add.
+    Single-owner under the shard lock, so ``push`` mutates in place;
+    padding lanes (id −1) and out-of-range ids are dropped, matching
+    ``ShardedParamStore.push``'s sentinel routing."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, values: np.ndarray):
+        self._v = np.asarray(values)
+
+    @classmethod
+    def from_values(cls, values) -> "_NumpyStore":
+        return cls(np.array(values, np.float32))
+
+    def values(self) -> np.ndarray:
+        return self._v
+
+    def push(self, local_ids, deltas) -> "_NumpyStore":
+        ids = np.asarray(local_ids, np.int64)
+        ok = (ids >= 0) & (ids < len(self._v))
+        if not ok.all():
+            ids = ids[ok]
+            deltas = np.asarray(deltas)[ok]
+        np.add.at(self._v, ids, np.asarray(deltas, self._v.dtype))
+        return self
+
+
 class ParamShard:
     """One shard's state: the local store slice + per-shard WAL.
 
@@ -241,6 +284,13 @@ class ParamShard:
     a single logical owner of its rows — the reference's per-subtask
     ``HashMap`` had the same serial discipline, enforced by Flink's
     operator model there and by this lock here).
+
+    ``store_backend`` picks the slice's array runtime: ``"jax"`` (the
+    default — the mesh-sharded store path every in-process topology
+    uses) or ``"numpy"`` (plain host arrays; what shard worker
+    PROCESSES run — see :class:`_NumpyStore`).  Both apply identical
+    fp32 scatter-adds over client-deduplicated ids, so the slices stay
+    bitwise-comparable.
     """
 
     def __init__(
@@ -256,7 +306,13 @@ class ParamShard:
         registry=None,
         hotkeys=None,
         profiler=None,
+        store_backend: str = "jax",
     ):
+        if store_backend not in ("jax", "numpy"):
+            raise ValueError(
+                f"store_backend={store_backend!r}: 'jax' | 'numpy'"
+            )
+        self._backend = store_backend
         self.shard_id = int(shard_id)
         self.partitioner = partitioner
         self.value_shape = tuple(int(s) for s in value_shape)
@@ -355,12 +411,37 @@ class ParamShard:
             self._c_pulls = self._c_pushes = self._c_restarts = None
 
     # -- construction / recovery -------------------------------------------
+    def _store_from_values(self, values):
+        """Build a store of the configured backend over ``values`` —
+        the one seam every slice (re)materialisation goes through, so
+        the jax/numpy choice lives in exactly one place."""
+        if self._backend == "numpy":
+            return _NumpyStore.from_values(np.asarray(values))
+        import jax.numpy as jnp
+
+        from ..core.store import ShardedParamStore
+
+        return ShardedParamStore.from_values(jnp.asarray(values))
+
     # fpsanalyze: allow[S001] _build writes run under self._lock at every call site (__init__ construction, restart) — the lock is the caller's
     def _build(self) -> None:
         """(Re)materialise the local slice from the deterministic init:
         local row j = init(owned[j]) — observationally the global
         table's row ``owned[j]`` (same per-id init contract as
-        :func:`~..core.store.create_table`)."""
+        :func:`~..core.store.create_table`).  Under the numpy backend
+        ``init_fn`` receives (and must return) host arrays — shard
+        worker processes never import jax."""
+        if self._backend == "numpy":
+            ids = np.asarray(self.owned, np.int64)
+            if self._init_fn is not None:
+                values = np.asarray(self._init_fn(ids), np.float32)
+            else:
+                values = np.zeros(
+                    ids.shape + self.value_shape, np.float32
+                )
+            self.store = _NumpyStore(values)
+            self._host_mirror = None
+            return
         import jax.numpy as jnp
 
         from ..core.store import ShardedParamStore
@@ -420,10 +501,6 @@ class ParamShard:
         """Rebuild the slice from an epoch-flip snapshot record: the
         logged ids must be exactly the partitioner's owned set for this
         shard (the shard was reconstructed with the post-flip map)."""
-        import jax.numpy as jnp
-
-        from ..core.store import ShardedParamStore
-
         ids = np.asarray(payload["ids"], np.int64)
         if not np.array_equal(ids, self.owned):
             raise RuntimeError(
@@ -433,16 +510,24 @@ class ParamShard:
                 f"snapshot was taken under"
             )
         values = np.asarray(payload["values"], np.float32)
-        self.store = ShardedParamStore.from_values(jnp.asarray(values))
+        self.store = self._store_from_values(values)
         self._host_mirror = None
         for pair in payload.get("pairs", ()):
             self._applied_pairs[(pair[0], int(pair[1]))] = None
         self._trim_pairs()
 
     def _apply(self, global_ids: np.ndarray, deltas: np.ndarray) -> None:
+        local = self.partitioner.to_local(self.shard_id, global_ids)
+        if self._backend == "numpy":
+            # host scatter-add in place: no shape-specialised kernels,
+            # so no pow2 bucketing either — padding existed for XLA's
+            # compile cache, and numpy has none to warm
+            self.store.push(local, deltas)
+            self._host_mirror = None
+            self.pushes_applied += 1
+            return
         import jax.numpy as jnp
 
-        local = self.partitioner.to_local(self.shard_id, global_ids)
         # Pad to a pow2 bucket BEFORE the scatter: the per-round unique
         # -id count varies, and jax compiles one scatter kernel per
         # shape — unquantised, every push is a fresh ~100 ms XLA
@@ -471,10 +556,6 @@ class ParamShard:
         the next epoch flip are STAGED and folded in at
         :meth:`install_epoch` (scale-in hands a survivor rows it cannot
         address under the pre-flip map)."""
-        import jax.numpy as jnp
-
-        from ..core.store import ShardedParamStore
-
         ids = np.asarray(global_ids, np.int64)
         values = np.asarray(values, np.float32)
         mine = self.partitioner.shard_of(ids) == self.shard_id
@@ -496,9 +577,7 @@ class ParamShard:
             self._host_mirror[local] = values[mine].astype(
                 self._host_mirror.dtype
             )
-            self.store = ShardedParamStore.from_values(
-                jnp.asarray(self._host_mirror)
-            )
+            self.store = self._store_from_values(self._host_mirror)
 
     def _remember_pairs(self, pid: str, ids: np.ndarray) -> None:
         for gid in ids:
@@ -770,10 +849,6 @@ class ParamShard:
         staged rows (scale-in inheritance) folded in — the freeze
         lifts, and a ``snapshot`` barrier record makes the post-flip
         WAL self-contained (replay never crosses a resharding)."""
-        import jax.numpy as jnp
-
-        from ..core.store import ShardedParamStore
-
         with self._lock:
             self._check_alive()
             if int(epoch) <= self.epoch:
@@ -802,7 +877,7 @@ class ParamShard:
                 rows[j] = self._staged[gid]
             self.partitioner = partitioner
             self.owned = new_owned
-            self.store = ShardedParamStore.from_values(jnp.asarray(rows))
+            self.store = self._store_from_values(rows)
             self._host_mirror = None
             self._staged = {}
             self._frozen = None
@@ -1219,6 +1294,20 @@ class ShardServer(LineServer):
     def _execute(self, line: str) -> str:
         toks = line.split()
         cmd = toks[0].lower()
+        if cmd == "hello":
+            # binary-framing negotiation (docs/cluster.md "Binary
+            # framing", utils/frames.py): "hello bin v=1" → "ok
+            # proto=bin v=1", and the connection accepts binary frames
+            # from then on (the net layer flips the conn ledger's
+            # proto on this exact answer).  Old servers reach their
+            # unknown-command ValueError instead — "err bad-request"
+            # — and the client stays on the line protocol: the PR-6
+            # versioning contract covering the whole framing.
+            if len(toks) >= 2 and toks[1].lower() == "bin":
+                return binf.HELLO_OK
+            raise ValueError(
+                f"unknown protocol {' '.join(toks[1:])!r} (try: bin)"
+            )
         if cmd == "pull":
             if len(toks) < 2:
                 raise ValueError(
@@ -1357,6 +1446,252 @@ class ShardServer(LineServer):
             f"unknown command {cmd!r} (pull|push|lease|revoke|xfer|load"
             f"|repl|replstate|flush|stats|conns)"
         )
+
+    # -- the binary frame protocol (utils/frames.py) -------------------------
+    def respond_frame(self, data: bytes) -> bytes:
+        """One binary request frame → one encoded response frame —
+        the binary twin of :meth:`respond`.  The overload guard admits
+        or sheds on the HEADER alone (verb id + priority byte), before
+        any TLV/id/payload work: under pressure, rejection stays the
+        cheapest path through the server, now without even a text
+        parse in front of it."""
+        with self.shard._depth_lock:
+            self.shard._active_requests += 1
+            depth = self.shard._active_requests
+        verb = "other"
+        t0 = time.perf_counter()
+        try:
+            try:
+                verb_id, _enc, prio, _total = binf.peek_header(data)
+            except binf.FrameError as e:
+                return binf.error_response(
+                    0, binf.STATUS_BAD_REQUEST, str(e)
+                )
+            verb = binf.VERB_NAMES.get(verb_id, "other")
+            guard = self.overload
+            if guard is not None and not guard.admit(
+                verb,
+                None if prio == binf.NO_PRIORITY else int(prio),
+                depth,
+            ):
+                return binf.error_response(
+                    verb_id, binf.STATUS_OVERLOADED
+                )
+            return self._respond_frame_supervised(data, verb_id, verb)
+        finally:
+            with self.shard._depth_lock:
+                self.shard._active_requests -= 1
+            if verb in ("pull", "push"):
+                self.profiler.observe(
+                    verb, "server_total", time.perf_counter() - t0
+                )
+
+    def _respond_frame_supervised(
+        self, data: bytes, verb_id: int, verb: str
+    ) -> bytes:
+        attempt = 0
+        while True:
+            try:
+                req = binf.decode(data, kind="request")
+                return self._dispatch_frame(req)
+            except ShardCrashed:
+                if not self.supervised:
+                    return binf.error_response(
+                        verb_id, binf.STATUS_CRASHED
+                    )
+                attempt += 1
+                if attempt > self.policy.max_restarts:
+                    return binf.error_response(
+                        verb_id, binf.STATUS_CRASHED,
+                        "restart budget exhausted",
+                    )
+                time.sleep(self.policy.backoff_s(attempt, self._rng))
+                self.shard.restart()
+            except StaleEpoch as e:
+                return binf.error_response(
+                    verb_id, binf.STATUS_STALE_EPOCH,
+                    tlvs=[(binf.T_EPOCH, str(e.shard_epoch).encode())],
+                )
+            except FrozenKeys:
+                return binf.error_response(verb_id, binf.STATUS_FROZEN)
+            except FollowerLagging as e:
+                return binf.error_response(
+                    verb_id, binf.STATUS_LAGGING,
+                    tlvs=[(binf.T_LAG, str(e.lag).encode())],
+                )
+            except NotPrimary:
+                return binf.error_response(
+                    verb_id, binf.STATUS_NOT_PRIMARY
+                )
+            except (binf.FrameError, ValueError, KeyError) as e:
+                return binf.error_response(
+                    verb_id, binf.STATUS_BAD_REQUEST, str(e)
+                )
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                return binf.error_response(
+                    verb_id, binf.STATUS_INTERNAL,
+                    f"{type(e).__name__}: {e}",
+                )
+
+    def _dispatch_frame(self, req) -> bytes:
+        tr = self.tracer
+        if tr is None or not tr.enabled:
+            return self._execute_frame(req)
+        from ..telemetry.distributed import parse_token
+
+        tok = req.tlv_str(binf.T_TRACE)
+        ctx = parse_token(tok) if tok else None
+        kwargs = (
+            {"trace_id": ctx.trace_id, "parent_id": ctx.span_id}
+            if ctx is not None else {}
+        )
+        with tr.span(f"shard.{req.verb_name}", "cluster", **kwargs):
+            return self._execute_frame(req)
+
+    @staticmethod
+    def _frame_ids(req) -> np.ndarray:
+        """The request's id section with the line protocol's bounds
+        (at least one id, frames stay bounded) — ZERO-COPY ``<i8``
+        over the receive buffer."""
+        ids = req.ids
+        if ids is None or ids.size == 0:
+            raise ValueError("need at least one id")
+        if ids.size > _MAX_IDS_PER_REQUEST:
+            raise ValueError(
+                f"{ids.size} ids in one request (max "
+                f"{_MAX_IDS_PER_REQUEST}); chunk the batch"
+            )
+        return ids
+
+    @staticmethod
+    def _row_enc(req) -> int:
+        """The row encoding the answer should use — the request's own
+        (fp32 default; bf16 when the client asked for it)."""
+        return (
+            req.enc if req.enc in (binf.ENC_F32, binf.ENC_BF16)
+            else binf.ENC_F32
+        )
+
+    def _inv_tlvs(self, sess: Optional[str]) -> list:
+        """Piggybacked lease invalidations as a response TLV — only
+        for frames that declared a session, exactly like the line
+        protocol's trailing ``inv=`` token (docs/hotcache.md)."""
+        if sess is None:
+            return []
+        inv = self.shard.leases.take_invalidations(sess)
+        return [] if not inv else [(binf.T_INV, inv.encode())]
+
+    def _execute_frame(self, req) -> bytes:
+        """The binary dispatch: same verbs, same shard methods, no
+        text — ids arrive as raw ``<i8``, rows as raw ``<f4``/bf16
+        (zero-copy views; the scatter path copies as it pads), and the
+        answer's rows leave as raw bytes again."""
+        shard = self.shard
+        verb = req.verb
+        epoch = None if req.aux == binf.NO_EPOCH else int(req.aux)
+        sess = req.tlv_str(binf.T_SESS)
+        if verb == binf.VERB_IDS["pull"]:
+            with self.profiler.timer("pull", "server_parse"):
+                ids = self._frame_ids(req)
+            vals = shard.pull(ids, epoch=epoch)
+            enc = self._row_enc(req)
+            with self.profiler.timer("pull", "response_serialize"):
+                resp = binf.encode_response(
+                    verb, n=int(ids.size), enc=enc,
+                    payload=binf.rows_to_payload(vals, enc),
+                    tlvs=self._inv_tlvs(sess),
+                )
+            return resp
+        if verb == binf.VERB_IDS["push"]:
+            with self.profiler.timer("push", "server_parse"):
+                ids = self._frame_ids(req)
+                deltas = binf.rows_from_payload(
+                    req.payload, shard.value_shape, req.enc
+                )
+            if len(deltas) != len(ids):
+                raise ValueError(
+                    f"{len(ids)} ids but {len(deltas)} delta rows"
+                )
+            seq = shard.push(
+                ids, deltas, epoch=epoch,
+                pid=req.tlv_str(binf.T_PID), sess=sess,
+            )
+            with self.profiler.timer("push", "response_serialize"):
+                resp = binf.encode_response(
+                    verb, aux=seq, n=int(ids.size), enc=binf.ENC_RAW,
+                    tlvs=self._inv_tlvs(sess),
+                )
+            return resp
+        if verb == binf.VERB_IDS["lease"]:
+            ids = self._frame_ids(req)
+            vals, seq, ttl = shard.lease_rows(
+                ids, sess, epoch=epoch, ttl=req.tlv_int(binf.T_TTL),
+            )
+            enc = self._row_enc(req)
+            return binf.encode_response(
+                verb, aux=seq, n=int(ids.size), enc=enc,
+                payload=binf.rows_to_payload(vals, enc),
+                tlvs=[(binf.T_TTL, str(ttl).encode())]
+                + self._inv_tlvs(sess),
+            )
+        if verb == binf.VERB_IDS["revoke"]:
+            ids = None if req.n == 0 else self._frame_ids(req)
+            n = shard.revoke_leases(sess, ids)
+            return binf.encode_response(verb, n=n, enc=binf.ENC_RAW)
+        if verb == binf.VERB_IDS["xfer"]:
+            ids = self._frame_ids(req)
+            vals, seq = shard.snapshot_rows(ids)
+            return binf.encode_response(
+                verb, aux=seq, n=int(ids.size), enc=binf.ENC_F32,
+                payload=binf.rows_to_payload(vals, binf.ENC_F32),
+            )
+        if verb == binf.VERB_IDS["load"]:
+            ids = self._frame_ids(req)
+            vals = binf.rows_from_payload(
+                req.payload, shard.value_shape, req.enc
+            )
+            if len(vals) != len(ids):
+                raise ValueError(
+                    f"{len(ids)} ids but {len(vals)} value rows"
+                )
+            seq = shard.assign_rows(ids, vals)
+            return binf.encode_response(
+                verb, aux=seq, n=int(ids.size), enc=binf.ENC_RAW
+            )
+        if verb == binf.VERB_IDS["repl"]:
+            # the replication stream: the payload IS the on-disk CRC
+            # record — raw bytes, no base64 (replication/shipper.py)
+            from ..resilience.wal import decode_frame_bytes
+
+            rec = decode_frame_bytes(bytes(req.payload))
+            ack = shard.apply_repl(rec, head=req.tlv_int(binf.T_HEAD))
+            return binf.encode_response(
+                verb, aux=int(ack["seq"]), n=int(ack["applied"]),
+                enc=binf.ENC_RAW,
+                tlvs=[(binf.T_SEG, str(ack["seg"]).encode())],
+            )
+        if verb == binf.VERB_IDS["replstate"]:
+            return binf.encode_response(
+                verb, enc=binf.ENC_RAW,
+                payload=json.dumps(shard.repl_state()).encode(),
+            )
+        if verb == binf.VERB_IDS["flush"]:
+            f = shard.flush()
+            return binf.encode_response(
+                verb, n=int(f["pushes"]), enc=binf.ENC_RAW,
+                tlvs=[(binf.T_WALREC, str(f["wal_records"]).encode())],
+            )
+        if verb == binf.VERB_IDS["stats"]:
+            return binf.encode_response(
+                verb, enc=binf.ENC_RAW,
+                payload=json.dumps(shard.stats()).encode(),
+            )
+        if verb == binf.VERB_IDS["conns"]:
+            return binf.encode_response(
+                verb, enc=binf.ENC_RAW,
+                payload=json.dumps(self.conn_table()).encode(),
+            )
+        raise ValueError(f"unknown verb id {verb}")
 
 
 __all__ = [

@@ -236,7 +236,9 @@ class LeaseBoard:
         """The ``inv=`` token value owed to ``sess`` (``"*"``, a
         comma-joined id list capped at ``inv_batch`` — the rest stays
         queued for the next response), or None when nothing is
-        pending."""
+        pending.  The binary framing piggybacks this exact value as a
+        ``T_INV`` TLV (utils/frames.py) — one grammar, two
+        carriages, both decoded by :func:`parse_inv_token`."""
         with self._lock:
             pend = self._pending.get(str(sess))
             if pend is None:

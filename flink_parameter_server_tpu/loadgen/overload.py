@@ -339,6 +339,11 @@ class OverloadGuard:
     """Shard-edge admission: shed by (verb class, frame priority) at
     live-depth thresholds.  ``admit`` runs BEFORE the request is
     parsed — shedding must be the cheapest thing the server does.
+    Over the line protocol that means before the id/payload split;
+    over the binary framing (utils/frames.py) it is cheaper still:
+    the verb id and priority are single header BYTES, so a shed
+    request costs one 24-byte header peek — no TLV, id, or payload
+    work at all (``ShardServer.respond_frame``).
 
     Effective threshold per request: write-class verbs (push / load /
     repl / flush) and ``pr=0`` frames use ``write_depth`` (None =

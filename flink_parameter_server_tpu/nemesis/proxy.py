@@ -64,6 +64,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..utils import frames as binframes
 from ..utils.net import LineServer
 
 # struct linger {onoff=1, linger=0}: close() becomes RST, not FIN —
@@ -159,18 +160,30 @@ class _FaultEngine:
     # -- one-shot faults ---------------------------------------------------
     def inject_once(
         self, kind: str, direction: str, *, keep_frac: float = 0.35,
-        count: int = 1,
+        count: int = 1, cut: str = "frame",
     ) -> None:
+        """``cut`` aims a ``truncate_rst`` inside a specific region of
+        a BINARY frame: ``"frame"`` (anywhere, ``keep_frac`` of the
+        bytes — the line-protocol behaviour too), ``"header"``
+        (strictly inside the 24-byte fixed header — the peer dies
+        before the length prefix completes), ``"payload"`` (past the
+        header, inside the TLV/id/row bytes — a torn payload under an
+        intact header).  Line frames fall back to the frac cut."""
         if kind not in _ONE_SHOT_KINDS:
             raise ValueError(f"kind {kind!r}: one of {_ONE_SHOT_KINDS}")
         if direction not in DIRECTIONS:
             raise ValueError(f"direction {direction!r}: 'c2s' | 's2c'")
         if not 0.0 < keep_frac < 1.0:
             raise ValueError(f"keep_frac={keep_frac}: must be in (0, 1)")
+        if cut not in ("frame", "header", "payload"):
+            raise ValueError(
+                f"cut={cut!r}: 'frame' | 'header' | 'payload'"
+            )
         with self._lock:
             for _ in range(int(count)):
                 self._one_shot[direction].append(
-                    {"kind": kind, "keep_frac": float(keep_frac)}
+                    {"kind": kind, "keep_frac": float(keep_frac),
+                     "cut": cut}
                 )
 
     def take_one_shot(self, direction: str) -> Optional[dict]:
@@ -290,10 +303,10 @@ class ChaosProxy(LineServer):
 
     def inject_once(
         self, kind: str, direction: str = "s2c", *,
-        keep_frac: float = 0.35, count: int = 1,
+        keep_frac: float = 0.35, count: int = 1, cut: str = "frame",
     ) -> None:
         self.engine.inject_once(
-            kind, direction, keep_frac=keep_frac, count=count
+            kind, direction, keep_frac=keep_frac, count=count, cut=cut
         )
 
     def half_open(self, count: int = 1) -> None:
@@ -378,10 +391,35 @@ class ChaosProxy(LineServer):
         except OSError:
             pass
 
+    @staticmethod
+    def _split_frames(buf: bytes):
+        """``(complete_frames, tail)`` — the link-level frame grammar
+        both protocols share: a chunk opening with the binary magic is
+        a length-prefixed frame (utils/frames.py; held until all its
+        bytes arrive — binary frames have no newline to wait for, and
+        may legitimately CONTAIN 0x0A bytes), anything else is a
+        newline line.  Byte-for-byte preserving in order, so every
+        fault class composes over either framing."""
+        frames: List[bytes] = []
+        while True:
+            if binframes.peek_is_binary(buf):
+                total = binframes.frame_length(buf)
+                if total is None or len(buf) < total:
+                    return frames, buf
+                frames.append(buf[:total])
+                buf = buf[total:]
+            else:
+                i = buf.find(b"\n")
+                if i < 0:
+                    return frames, buf
+                frames.append(buf[: i + 1])
+                buf = buf[i + 1:]
+
     def _pump(self, src, dst, direction: str) -> None:
-        """Relay ``src → dst``, one complete newline frame at a time
-        (partial tails are held until their newline arrives, so frame
-        faults see whole frames; the tail is flushed raw on EOF)."""
+        """Relay ``src → dst``, one complete frame at a time — newline
+        lines or length-prefixed binary frames (partial tails are held
+        until complete, so frame faults see whole frames; the tail is
+        flushed raw on EOF)."""
         eng = self.engine
         buf = b""
         ctx: dict = {}
@@ -407,9 +445,9 @@ class ChaosProxy(LineServer):
                         pass
                     return
                 buf += data
-                *frames, buf = buf.split(b"\n")
+                frames, buf = self._split_frames(buf)
                 for f in frames:
-                    self._relay_frame(f + b"\n", direction, ctx, src, dst)
+                    self._relay_frame(f, direction, ctx, src, dst)
         except _Aborted:
             return
         finally:
@@ -442,8 +480,28 @@ class ChaosProxy(LineServer):
                 # cut strictly mid-frame (never 0, never the full
                 # frame incl. newline), then abort both legs: the
                 # peer sees a torn payload and a reset, exactly the
-                # mid-b64 death the dedupe ledger must survive
-                keep = max(1, int((len(frame) - 1) * shot["keep_frac"]))
+                # mid-b64 death the dedupe ledger must survive.  For
+                # BINARY frames, cut="header"/"payload" aims the tear
+                # inside the 24-byte fixed header or past it — the two
+                # torn-read shapes a length-prefixed reader must
+                # survive (mid-header: the length never arrives;
+                # mid-payload: the length promised more than EOF
+                # delivered).
+                cut = shot.get("cut", "frame")
+                is_bin = binframes.peek_is_binary(frame)
+                hdr = binframes.HEADER_SIZE
+                if is_bin and cut == "header" and len(frame) > 2:
+                    hi = min(hdr, len(frame)) - 1
+                    keep = max(1, min(hi, int(hdr * shot["keep_frac"])))
+                elif is_bin and cut == "payload" and len(frame) > hdr + 1:
+                    body = len(frame) - hdr
+                    keep = hdr + max(
+                        1, min(body - 1, int(body * shot["keep_frac"]))
+                    )
+                else:
+                    keep = max(
+                        1, int((len(frame) - 1) * shot["keep_frac"])
+                    )
                 self._count_fault("truncate_rst")
                 try:
                     dst.sendall(frame[:keep])

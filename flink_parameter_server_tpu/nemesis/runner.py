@@ -282,6 +282,7 @@ def _execute_op(driver, op: NemesisOp) -> None:
         }[a]
         proxy.inject_once(
             kind, direction, keep_frac=op.keep_frac, count=op.count,
+            cut=op.cut,
         )
     elif a == "half_open":
         proxy.half_open(op.count)

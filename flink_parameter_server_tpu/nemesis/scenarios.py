@@ -76,6 +76,12 @@ class NemesisOp:
     keep_frac: float = 0.35
     count: int = 1
     gid: int = 0
+    # truncate_next aim over BINARY frames (utils/frames.py): "frame"
+    # cuts anywhere (keep_frac of the bytes — the line-protocol cut
+    # too), "header" tears inside the 24-byte fixed header (the
+    # length prefix never completes), "payload" past it (the length
+    # promised more than EOF delivered)
+    cut: str = "frame"
 
     def __post_init__(self):
         if self.action not in ACTIONS:
@@ -295,29 +301,34 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
         staleness_bound=2,
         parity=False,
     ),
-    # 8. mid-frame RST on a pull RESPONSE: the b64 payload is torn
+    # 8. mid-frame RST on a pull RESPONSE: the payload is torn
     # mid-frame and the connection reset — the client replays; pulls
-    # are idempotent, parity holds
+    # are idempotent, parity holds.  Over the binary transport the
+    # two cuts are AIMED: one inside the 24-byte fixed header (the
+    # length prefix never completes), one inside the row payload (the
+    # length promised more than EOF delivered) — the two torn-read
+    # shapes a length-prefixed reader must survive.
     Scenario(
         "mid_frame_rst_pull",
         (
             NemesisOp(3, "truncate_next", shard=0, mode="s2c",
-                      keep_frac=0.4),
+                      keep_frac=0.4, cut="header"),
             NemesisOp(7, "truncate_next", shard=0, mode="s2c",
-                      keep_frac=0.7),
+                      keep_frac=0.7, cut="payload"),
         ),
         seed=108,
     ),
     # 9. mid-frame RST on a push REQUEST: the delta payload dies
     # mid-wire; the replay carries the same pid, the (pid,id) ledger
-    # absorbs any half-applied ambiguity — exactly-once audit balances
+    # absorbs any half-applied ambiguity — exactly-once audit
+    # balances.  Same header/payload aim as #8, on the request leg.
     Scenario(
         "mid_frame_rst_push",
         (
             NemesisOp(3, "truncate_next", shard=0, mode="c2s",
-                      keep_frac=0.3),
+                      keep_frac=0.3, cut="header"),
             NemesisOp(7, "truncate_next", shard=1, mode="c2s",
-                      keep_frac=0.6),
+                      keep_frac=0.6, cut="payload"),
         ),
         seed=109,
     ),

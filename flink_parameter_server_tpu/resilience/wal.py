@@ -70,30 +70,28 @@ class WALRecord:
         return self.start_step + self.n_steps
 
 
-def encode_frame(start_step: int, n_steps: int, payload: Any) -> str:
-    """One WAL record as a wire-safe token: the exact on-disk framing
-    (``REC_MAGIC`` + header + CRC32 + pickled payload), base64'd.  This
-    is the replication stream's unit (``repl`` verb, cluster/shard.py):
-    the same CRC that guards a segment against a torn tail guards a
-    shipped record against wire corruption."""
+def encode_frame_bytes(
+    start_step: int, n_steps: int, payload: Any
+) -> bytes:
+    """One WAL record in the exact on-disk framing (``REC_MAGIC`` +
+    header + CRC32 + pickled payload) as RAW bytes — the replication
+    stream's unit over the binary transport (utils/frames.py ``repl``
+    payload): the same CRC that guards a segment against a torn tail
+    guards a shipped record against wire corruption, with no base64
+    round trip in between."""
     blob = pickle.dumps(payload, protocol=4)
-    frame = (
+    return (
         REC_MAGIC
         + _REC_HDR.pack(0, int(start_step), int(n_steps), len(blob),
                         zlib.crc32(blob))
         + blob
     )
-    return base64.b64encode(frame).decode("ascii")
 
 
-def decode_frame(token: str) -> WALRecord:
-    """Inverse of :func:`encode_frame`; raises ``ValueError`` on a bad
-    magic, short frame, or CRC mismatch (a corrupt shipped record must
-    be rejected at the wire, never applied)."""
-    try:
-        raw = base64.b64decode(token.encode("ascii"), validate=True)
-    except Exception as e:
-        raise ValueError(f"repl frame is not valid base64: {e}") from None
+def decode_frame_bytes(raw: bytes) -> WALRecord:
+    """Inverse of :func:`encode_frame_bytes`; raises ``ValueError`` on
+    a bad magic, short frame, or CRC mismatch (a corrupt shipped
+    record must be rejected at the wire, never applied)."""
     hdr_len = len(REC_MAGIC) + _REC_HDR.size
     if len(raw) < hdr_len or raw[: len(REC_MAGIC)] != REC_MAGIC:
         raise ValueError("repl frame: bad record magic")
@@ -107,6 +105,24 @@ def decode_frame(token: str) -> WALRecord:
             f"bytes)"
         )
     return WALRecord(seq, start, n_steps, pickle.loads(blob))
+
+
+def encode_frame(start_step: int, n_steps: int, payload: Any) -> str:
+    """:func:`encode_frame_bytes`, base64'd — the line-protocol
+    (``repl <b64-frame>``) rendering of the same record."""
+    return base64.b64encode(
+        encode_frame_bytes(start_step, n_steps, payload)
+    ).decode("ascii")
+
+
+def decode_frame(token: str) -> WALRecord:
+    """Inverse of :func:`encode_frame`; raises ``ValueError`` on bad
+    base64 or any :func:`decode_frame_bytes` failure."""
+    try:
+        raw = base64.b64decode(token.encode("ascii"), validate=True)
+    except Exception as e:
+        raise ValueError(f"repl frame is not valid base64: {e}") from None
+    return decode_frame_bytes(raw)
 
 
 class UpdateWAL:
@@ -459,4 +475,11 @@ class UpdateWAL:
         self.close()
 
 
-__all__ = ["UpdateWAL", "WALRecord", "encode_frame", "decode_frame"]
+__all__ = [
+    "UpdateWAL",
+    "WALRecord",
+    "decode_frame",
+    "decode_frame_bytes",
+    "encode_frame",
+    "encode_frame_bytes",
+]

@@ -461,10 +461,16 @@ class ServingServer(LineServer):
     """Line-protocol TCP front end over a :class:`ServingService`.
 
     ``port=0`` binds an ephemeral port (read it back from ``.port``).
-    The socket plumbing (accept loop, per-connection threads, the line
-    reassembly + overflow guard, shutdown) lives in
-    :class:`~..utils.net.LineServer`; this class is the protocol —
-    :meth:`respond` answers one request line with one response line.
+    The socket plumbing (the selectors event loop, per-connection read
+    buffers + dispatchers, the line reassembly + overflow guard,
+    shutdown) lives in :class:`~..utils.net.LineServer`; this class is
+    the protocol — :meth:`respond` answers one request line with one
+    response line.  The serving plane deliberately stays on the line
+    protocol: its answers are id lists and scores, not row payloads,
+    so binary framing buys nothing here — a cluster-style ``hello``
+    handshake lands in the unknown-command branch (``err
+    bad-request``), which is exactly the downgrade answer a
+    negotiating client expects (docs/cluster.md "Binary framing").
     """
 
     def __init__(

@@ -66,7 +66,10 @@ def new_trace() -> TraceContext:
 
 
 def format_token(ctx: TraceContext) -> str:
-    """The wire form: ``t=<trace>:<span>``."""
+    """The LINE-protocol wire form: ``t=<trace>:<span>``.  The binary
+    framing (utils/frames.py) carries the bare :meth:`TraceContext.
+    token` value as a ``T_TRACE`` TLV instead — same grammar, parsed
+    by the same :func:`parse_token` on the server."""
     return f"{TRACE_OPT}={ctx.token()}"
 
 

@@ -60,7 +60,14 @@ from .registry import MetricsRegistry, get_registry, json_line
 
 # Canonical phase order of one cluster round (docs/observability.md).
 # ``wire`` and ``server_other`` are residuals derived at budget time;
-# everything else is measured at its call site.
+# everything else is measured at its call site.  The BINARY transport
+# (utils/frames.py) reuses these names — frame encode IS
+# client_serialize, frame decode IS server_parse — which is what keeps
+# the line-vs-binary A/B (results/cpu/transport_ab.md) directly
+# comparable.  The vocabulary is pinned in lockstep with
+# ``tools/check_metric_lines.KNOWN_BUDGET_PHASES`` (a tier-1 test
+# compares the two), so a renamed/added phase must update the lint,
+# the docs, and this tuple together.
 PHASES: Tuple[str, ...] = (
     "client_serialize",
     "wire",

@@ -1,49 +1,70 @@
-"""Shared TCP line-server skeleton.
+"""Shared TCP server skeleton: selectors event loop + framed dispatch.
 
-Three front ends in this repo speak the same newline-delimited TCP
-idiom — the serving plane (``serving/server.py``), the telemetry scrape
-endpoint (``telemetry/exporter.py``), and the cluster parameter-server
-shards (``cluster/shard.py``) — and before this module each carried its
-own copy of the socket plumbing: bind + ephemeral-port readback, the
-accept loop on a daemon thread, per-connection handler threads,
-connection tracking, and the close-everything shutdown dance.
+Three front ends in this repo speak request/response TCP — the serving
+plane (``serving/server.py``), the telemetry scrape endpoint
+(``telemetry/exporter.py``), and the cluster parameter-server shards
+(``cluster/shard.py``) — over :class:`LineServer`, the socket skeleton
+factored once.  Subclasses pick an override point:
 
-:class:`LineServer` is that skeleton, factored once.  Subclasses pick
-one of two override points:
-
-  * ``respond(line) -> str`` — the common case: a persistent
-    line-per-request protocol (one response line per request, in order,
-    per connection).  The base class owns the recv/split/reassemble
-    loop, including the ``max_line_bytes`` overflow guard.
+  * ``respond(line) -> str`` — the line protocol: one response line per
+    request line, in order, per connection;
+  * ``respond_frame(frame_bytes) -> bytes`` — the BINARY protocol
+    (utils/frames.py): one response frame per request frame, same
+    ordering contract.  A connection opts in by sending the text
+    ``hello bin v=1`` handshake first (the server's ``respond`` answers
+    it; ``ok proto=bin`` flips the connection) — after that, every
+    inbound frame is self-describing by its two non-ASCII magic bytes,
+    so text lines and binary frames can share one connection (the
+    mixed-fleet rollout path, docs/cluster.md "Binary framing");
   * ``handle_connection(conn)`` — full control of one accepted socket
-    (the telemetry endpoint's one-shot HTTP-or-bare-line answer).
+    (the telemetry endpoint's one-shot HTTP answer, the chaos proxy's
+    byte relay).  Subclasses overriding this keep the legacy
+    thread-per-connection accept loop.
+
+I/O model (ROADMAP item 1): servers dispatching via ``respond``/
+``respond_frame`` run ONE selectors-based event loop thread that owns
+accept and every IDLE socket — per-connection read buffers, frame
+reassembly (newline or length-prefixed binary).  The first complete
+request hands the socket to a per-connection dispatcher thread (lazily
+started, FIFO — the ordering contract), which serves the queue and
+then keeps ``recv``-ing the socket DIRECTLY while traffic keeps
+arriving (``LINGER_S``): an active connection is one thread and two
+kernel wakeups per round — the measured loopback floor — while a
+connection idle past the linger parks back in the selector and costs
+a table entry, not a blocked thread.  A slow ``respond`` (shard lock,
+scatter) never stalls OTHER connections, and backpressure is the
+ownership rule itself: while the dispatcher owns the socket nobody
+reads ahead of it, so the TCP window pushes back on the peer exactly
+as the old blocked-in-``recv`` handler did.
 
 Lifecycle: ``start()`` is idempotent, ``stop()`` closes the listener
-and every tracked connection and joins the accept thread AND the
-per-connection handler threads (with a timeout) — repeated
-start/stop cycles in one process (the elastic scale-in/out path) must
-not leak a thread per connection ever accepted; the context
-manager form pairs them.  ``port=0`` binds an ephemeral port — read it
-back from ``.port`` (the test/fixture pattern every front end uses).
+and every tracked connection and joins the I/O thread AND the
+dispatcher threads (with a timeout) — repeated start/stop cycles in
+one process (the elastic scale-in/out path) must not leak a thread per
+connection ever accepted; the context manager form pairs them.
+``port=0`` binds an ephemeral port — read it back from ``.port``.
 
 Wire accounting (the latency-budget profiler's byte ledger,
-docs/observability.md): every frame through the line loop — and every
-frame the :func:`request_lines` client helper moves — is counted into
-the metrics registry as ``net_bytes_total`` / ``net_frames_total``
-with ``{direction=in|out, verb=<first token>, role=server|client}``
-labels (``fps_``-prefixed on ``/metrics``).  Until this existed,
-bytes-on-wire was invisible: ROADMAP item 4's "bytes down" acceptance
-criterion had no baseline, and ROADMAP item 2's framing rework had no
-number to beat.  Per-connection totals (bytes/frames each way, peer,
-age) are kept too and served by :meth:`LineServer.conn_table` — the
-``psctl conns`` surface.
+docs/observability.md): every frame through the dispatch loop — and
+every frame the :func:`request_lines` client helper moves — is counted
+into the metrics registry as ``net_bytes_total`` / ``net_frames_total``
+with ``{direction=in|out, verb=<verb>, role=server|client}`` labels
+(``fps_``-prefixed on ``/metrics``); binary frames attribute their
+header's verb id.  Per-connection totals (bytes/frames each way, peer,
+age, negotiated protocol + payload encoding) are kept too and served
+by :meth:`LineServer.conn_table` — the ``psctl conns`` surface, which
+is how an operator sees a mixed line/binary fleet mid-rollout.
 """
 from __future__ import annotations
 
+import collections
+import selectors
 import socket
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
+
+from . import frames as binframes
 
 
 class PeerHalfClosed(ConnectionError):
@@ -179,11 +200,15 @@ def client_meter() -> NetMeter:
 
 class ConnStats:
     """Per-connection wire ledger (updated only by the connection's
-    own handler thread; read by :meth:`LineServer.conn_table`)."""
+    own dispatcher/handler thread; read by
+    :meth:`LineServer.conn_table`).  ``proto`` is the negotiated
+    framing (``line`` until a successful binary hello), ``enc`` the
+    last payload encoding seen on a binary frame — the two columns
+    that make a mixed-version fleet visible in ``psctl conns``."""
 
     __slots__ = (
         "peer", "connected_at", "bytes_in", "bytes_out",
-        "frames_in", "frames_out", "last_verb",
+        "frames_in", "frames_out", "last_verb", "proto", "enc",
     )
 
     def __init__(self, peer: str):
@@ -194,6 +219,8 @@ class ConnStats:
         self.frames_in = 0
         self.frames_out = 0
         self.last_verb = ""
+        self.proto = "line"
+        self.enc = ""
 
     def as_dict(self) -> dict:
         return {
@@ -204,17 +231,53 @@ class ConnStats:
             "frames_in": self.frames_in,
             "frames_out": self.frames_out,
             "last_verb": self.last_verb,
+            "proto": self.proto,
+            "enc": self.enc,
         }
 
 
-class LineServer:
-    """Reusable accept-loop + per-connection-thread TCP server.
+class _ConnState:
+    """One connection's event-loop state: the socket, its read buffer,
+    the FIFO of complete-but-unserved requests, and the dispatcher
+    coordination.  Queue/flags are guarded by ``cond``'s lock (shared
+    io-thread/dispatcher state); the buffer is touched only by the io
+    thread, the socket writes only by the dispatcher."""
 
-    One handler thread per connection; connections are tracked so
-    ``stop()`` can unblock handlers mid-``recv``.  Subclasses implement
-    :meth:`respond` (line protocol) or override
-    :meth:`handle_connection` (raw socket).
+    __slots__ = (
+        "sock", "stats", "buf", "queue", "cond", "eof", "closed",
+        "owned", "dispatcher_started", "overflow",
+    )
+
+    def __init__(self, sock: socket.socket, stats: ConnStats):
+        self.sock = sock
+        self.stats = stats
+        self.buf = bytearray()
+        self.queue: Deque[Tuple[str, bytes]] = collections.deque()
+        self.cond = threading.Condition()
+        self.eof = False
+        self.closed = False
+        # True while the DISPATCHER owns the socket's read side (the
+        # active-connection fast path — see LineServer._linger_read);
+        # the io thread reads only while this is False
+        self.owned = False
+        self.dispatcher_started = False
+        self.overflow: Optional[str] = None  # "line" | "bin" | None
+
+
+class LineServer:
+    """Reusable TCP server: a selectors event loop feeding per-
+    connection dispatcher threads (``respond``/``respond_frame``
+    servers), or the legacy thread-per-connection accept loop for
+    subclasses overriding :meth:`handle_connection`.
     """
+
+    # how long an ACTIVE connection's dispatcher keeps reading its own
+    # socket before parking it back in the selector: request/response
+    # traffic inside this window is served entirely on one thread (two
+    # kernel wakeups per round — the measured loopback floor), while a
+    # connection idle past it costs a selector entry instead of a
+    # blocked thread.  See _linger_read.
+    LINGER_S = 0.5
 
     def __init__(
         self,
@@ -240,12 +303,16 @@ class LineServer:
         self._accept_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._conns: List[socket.socket] = []
+        self._states: Dict[socket.socket, _ConnState] = {}
         self._handlers: List[threading.Thread] = []
         self._conns_lock = threading.Lock()
+        # connections a dispatcher drained below the backpressure
+        # threshold — the io loop re-registers them each tick
+        self._resume: Deque[_ConnState] = collections.deque()
         self.connections_accepted = 0  # lifetime count (observability)
 
     def live_connections(self) -> int:
-        """Currently-open handler connections (the lifetime count is
+        """Currently-open connections (the lifetime count is
         :attr:`connections_accepted`) — the churn observability the
         span-tracer leak regression test reads alongside
         ``SpanTracer.stack_count()``."""
@@ -254,7 +321,8 @@ class LineServer:
 
     def conn_table(self) -> List[dict]:
         """Live per-connection wire ledger — peer, age, bytes/frames
-        each way, last verb — the ``psctl conns`` answer."""
+        each way, last verb, negotiated proto/enc — the ``psctl
+        conns`` answer."""
         with self._conns_lock:
             stats = list(self._conn_stats.values())
         return [s.as_dict() for s in stats]
@@ -268,12 +336,23 @@ class LineServer:
         return st
 
     # -- lifecycle ---------------------------------------------------------
+    def _uses_event_loop(self) -> bool:
+        """Default servers (``respond``/``respond_frame``) run the
+        selectors loop; subclasses overriding ``handle_connection``
+        keep the legacy thread-per-connection accept loop."""
+        return (
+            type(self).handle_connection is LineServer.handle_connection
+        )
+
     def start(self) -> "LineServer":
         if self._accept_thread is None or not self._accept_thread.is_alive():
             self._stop.clear()
+            target = (
+                self._io_loop if self._uses_event_loop()
+                else self._accept_loop
+            )
             self._accept_thread = threading.Thread(
-                target=self._accept_loop, name=f"{self.name}-accept",
-                daemon=True,
+                target=target, name=f"{self.name}-io", daemon=True,
             )
             self._accept_thread.start()
         return self
@@ -296,31 +375,35 @@ class LineServer:
         except OSError:
             pass
         with self._conns_lock:
-            for c in self._conns:
-                try:
-                    # a handler blocked in recv() does not notice close()
-                    # alone on all platforms; shutdown() interrupts it
-                    c.shutdown(socket.SHUT_RDWR)
-                except OSError:
-                    pass
-                try:
-                    c.close()
-                except OSError:
-                    pass
-            self._conns.clear()
+            conns = list(self._conns)
+            states = list(self._states.values())
             handlers = list(self._handlers)
-            self._handlers.clear()
+            self._handlers = []
+        for c in conns:
+            try:
+                # a handler blocked in recv() does not notice close()
+                # alone on all platforms; shutdown() interrupts it
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for st in states:
+            with st.cond:
+                st.cond.notify_all()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
             self._accept_thread = None
-        # join the per-connection handler threads: a scale-in/out cycle
+        # join the dispatcher/handler threads: a scale-in/out cycle
         # that stops servers repeatedly in ONE process must not leak a
         # thread (and its socket buffers) per connection ever accepted
         for t in handlers:
             if t is not threading.current_thread():
                 t.join(timeout=5)
         # final sweep: a connection accepted concurrently with the
-        # clear above may have registered afterwards — its handler
+        # snapshot above may have registered afterwards — its handler
         # exits on the stop flag; close its socket, join it, prune
         with self._conns_lock:
             for c in self._conns:
@@ -333,6 +416,9 @@ class LineServer:
                 except OSError:
                     pass
             self._conns.clear()
+            for st in self._states.values():
+                with st.cond:
+                    st.cond.notify_all()
             late = list(self._handlers)
         for t in late:
             if t is not threading.current_thread():
@@ -355,31 +441,339 @@ class LineServer:
     def __exit__(self, *exc) -> None:
         self.stop()
 
-    # -- internals ---------------------------------------------------------
+    # -- shared accept bookkeeping -----------------------------------------
+    def _setup_accepted(
+        self, conn: socket.socket, addr
+    ) -> Optional[ConnStats]:
+        try:
+            # request/response protocols: answer frames must not sit
+            # in Nagle's buffer waiting for a delayed ACK (measured
+            # ~40 ms/frame stalls on loopback without this)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        stats = ConnStats(f"{addr[0]}:{addr[1]}")
+        with self._conns_lock:
+            self._conns.append(conn)
+            self._conn_stats.setdefault(conn, stats)
+            self.connections_accepted += 1
+            # prune finished threads so the tracking list stays
+            # bounded by LIVE connections, not total ever accepted
+            self._handlers = [
+                t for t in self._handlers if t.is_alive()
+            ]
+        return stats
+
+    def _forget_conn(self, conn: socket.socket) -> None:
+        with self._conns_lock:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            self._conn_stats.pop(conn, None)
+            self._states.pop(conn, None)
+
+    # -- the selectors event loop ------------------------------------------
+    def _io_loop(self) -> None:
+        sel = selectors.DefaultSelector()
+        try:
+            sel.register(self._sock, selectors.EVENT_READ, None)
+        except (OSError, ValueError):
+            sel.close()
+            return
+        try:
+            while not self._stop.is_set():
+                while True:
+                    try:
+                        st = self._resume.popleft()
+                    except IndexError:
+                        break
+                    self._register(sel, st)
+                try:
+                    events = sel.select(timeout=0.05)
+                except OSError:
+                    return
+                for key, _mask in events:
+                    st = key.data
+                    if st is None:
+                        self._io_accept(sel)
+                    else:
+                        self._io_read(sel, st)
+        finally:
+            try:
+                sel.close()
+            except OSError:
+                pass
+
+    def _register(self, sel, st: _ConnState) -> None:
+        with st.cond:
+            if st.closed or st.owned:
+                return
+        try:
+            sel.register(st.sock, selectors.EVENT_READ, st)
+        except KeyError:
+            # a stale map entry from a closed fd that was reused:
+            # evict it, then register the live connection
+            try:
+                sel.unregister(st.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            try:
+                sel.register(st.sock, selectors.EVENT_READ, st)
+            except (ValueError, OSError):
+                pass
+        except (ValueError, OSError):
+            pass
+
+    def _io_accept(self, sel) -> None:
+        try:
+            conn, addr = self._sock.accept()
+        except OSError:
+            return
+        stats = self._setup_accepted(conn, addr)
+        st = _ConnState(conn, stats)
+        with self._conns_lock:
+            self._states[conn] = st
+        self._register(sel, st)
+
+    def _io_read(self, sel, st: _ConnState) -> None:
+        try:
+            data = st.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
+            try:
+                sel.unregister(st.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            started = st.dispatcher_started
+            with st.cond:
+                st.eof = True
+                st.cond.notify_all()
+            if not started:
+                self._close_state(st)
+            return
+        st.buf += data
+        if self._extract_requests(st):
+            # hand the socket's read side to the dispatcher (the
+            # active-connection fast path): it serves the queue, then
+            # keeps recv'ing directly — one thread, two kernel wakeups
+            # per round — until the connection idles past LINGER_S and
+            # parks back here.  While owned, this loop never touches
+            # the socket, which is also the backpressure: a slow
+            # dispatcher simply stops reading and TCP pushes back.
+            with st.cond:
+                st.owned = True
+            try:
+                sel.unregister(st.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+
+    def _extract_requests(self, st: _ConnState) -> int:
+        """Split the connection buffer into complete requests —
+        newline lines or length-prefixed binary frames, each
+        self-describing by its leading bytes — and enqueue them for
+        the dispatcher.  Returns how many items were enqueued."""
+        items: List[Tuple[str, bytes]] = []
+        overflow: Optional[str] = None
+        buf = st.buf
+        while True:
+            if binframes.peek_is_binary(buf):
+                total = binframes.frame_length(buf)
+                if total is None:
+                    break
+                if total > self.max_line_bytes:
+                    overflow = "bin"
+                    break
+                if len(buf) < total:
+                    break
+                items.append(("bin", bytes(buf[:total])))
+                del buf[:total]
+            else:
+                i = buf.find(b"\n")
+                if i < 0:
+                    if len(buf) > self.max_line_bytes:
+                        overflow = "line"
+                    break
+                raw = bytes(buf[:i])
+                del buf[: i + 1]
+                items.append(("line", raw))
+        if not items and overflow is None:
+            return 0
+        with st.cond:
+            st.queue.extend(items)
+            if overflow is not None:
+                st.overflow = overflow
+                st.queue.append(("overflow", b""))
+            st.cond.notify_all()
+        self._ensure_dispatcher(st)
+        return len(items) + (0 if overflow is None else 1)
+
+    def _ensure_dispatcher(self, st: _ConnState) -> None:
+        if st.dispatcher_started:
+            return
+        st.dispatcher_started = True
+        with self._conns_lock:
+            t = threading.Thread(
+                target=self._dispatch_loop, args=(st,), daemon=True,
+                name=f"{self.name}-conn-{self.connections_accepted}",
+            )
+            self._handlers.append(t)
+        t.start()
+
+    def _dispatch_loop(self, st: _ConnState) -> None:
+        try:
+            while True:
+                kind = data = None
+                with st.cond:
+                    while True:
+                        if st.closed or self._stop.is_set():
+                            return
+                        if st.queue:
+                            kind, data = st.queue.popleft()
+                            break
+                        if st.eof:
+                            return  # everything served
+                        if st.owned:
+                            break  # queue drained: read the socket
+                        st.cond.wait(0.1)
+                if kind is None:
+                    if not self._linger_read(st):
+                        return
+                    continue
+                if not self._serve_one(st, kind, data):
+                    return
+        except OSError:
+            pass
+        except Exception:  # noqa: BLE001 — a poisoned frame must not
+            pass  # leak the connection; respond() itself never raises
+        finally:
+            self._close_state(st)
+
+    def _linger_read(self, st: _ConnState) -> bool:
+        """The active-connection fast path: while this dispatcher owns
+        the socket, it recv's directly — request/response traffic is
+        then one thread and two kernel wakeups per round, the measured
+        loopback floor, instead of bouncing through the io thread.  A
+        connection idle past ``LINGER_S`` is handed back to the
+        selector (the io thread re-registers it from ``_resume``), so
+        an idle connection costs a table entry, not a thread.  Returns
+        False when the connection is going down."""
+        try:
+            st.sock.settimeout(self.LINGER_S)
+            data = st.sock.recv(1 << 16)
+        except socket.timeout:
+            try:
+                st.sock.settimeout(None)
+            except OSError:
+                return False
+            with st.cond:
+                st.owned = False
+            self._resume.append(st)
+            return True
+        except OSError:
+            return False
+        if not data:
+            with st.cond:
+                st.eof = True
+            return True
+        try:
+            # back to fully blocking before any respond() sendall — a
+            # response stalled on TCP backpressure (a held partition)
+            # must BLOCK like the old handler did, not die at the
+            # linger deadline
+            st.sock.settimeout(None)
+        except OSError:
+            return False
+        st.buf += data
+        self._extract_requests(st)
+        return True
+
+    def _serve_one(self, st: _ConnState, kind: str, data: bytes) -> bool:
+        """Serve one request on the dispatcher thread; returns False
+        when the connection must close (overflow discipline)."""
+        stats = st.stats
+        if kind == "overflow":
+            if st.overflow == "bin":
+                payload = binframes.error_response(
+                    0, binframes.STATUS_BAD_REQUEST, "frame too long"
+                )
+            else:
+                payload = b"err bad-request: line too long\n"
+            try:
+                st.sock.sendall(payload)
+            except OSError:
+                pass
+            return False
+        if kind == "bin":
+            verb = binframes.peek_verb_name(data)
+            stats.last_verb = verb
+            try:
+                _v, enc, _f, _t = binframes.peek_header(data)
+                stats.enc = binframes.ENC_NAMES.get(enc, "?")
+            except binframes.FrameError:
+                pass
+            stats.bytes_in += len(data)
+            stats.frames_in += 1
+            self.meter.count("in", verb, len(data))
+            resp = self.respond_frame(data)
+            if resp is not None:
+                # ledger BEFORE the write: a client that has read the
+                # response must never observe a table that hasn't
+                # counted it yet
+                stats.bytes_out += len(resp)
+                stats.frames_out += 1
+                self.meter.count("out", verb, len(resp))
+                st.sock.sendall(resp)
+            return True
+        line = data.decode("utf-8", "replace").strip()
+        if not line:
+            return True
+        verb = _safe_verb(line)
+        stats.last_verb = verb
+        stats.bytes_in += len(data) + 1
+        stats.frames_in += 1
+        self.meter.count("in", verb, len(data) + 1)
+        resp = self.respond(line)
+        if resp is not None:
+            payload = resp.encode("utf-8") + b"\n"
+            stats.bytes_out += len(payload)
+            stats.frames_out += 1
+            self.meter.count("out", verb, len(payload))
+            st.sock.sendall(payload)
+            if verb == "hello" and resp.startswith("ok proto=bin"):
+                # negotiation accepted: record it (frames were already
+                # acceptable — they are self-describing — but the
+                # conn ledger shows the negotiated protocol)
+                stats.proto = "bin"
+        return True
+
+    def _close_state(self, st: _ConnState) -> None:
+        with st.cond:
+            if st.closed:
+                return
+            st.closed = True
+        try:
+            st.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+        self._forget_conn(st.sock)
+
+    # -- the legacy thread-per-connection path ------------------------------
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
             try:
                 conn, addr = self._sock.accept()
             except OSError:
                 return  # listener closed
-            try:
-                # request/response protocols: answer frames must not sit
-                # in Nagle's buffer waiting for a delayed ACK (measured
-                # ~40 ms/frame stalls on loopback without this)
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            except OSError:
-                pass
+            self._setup_accepted(conn, addr)
             with self._conns_lock:
-                self._conns.append(conn)
-                self._conn_stats.setdefault(
-                    conn, ConnStats(f"{addr[0]}:{addr[1]}")
-                )
-                self.connections_accepted += 1
-                # prune finished handlers so the tracking list stays
-                # bounded by LIVE connections, not total ever accepted
-                self._handlers = [
-                    t for t in self._handlers if t.is_alive()
-                ]
                 t = threading.Thread(
                     target=self._handle_and_close, args=(conn,),
                     daemon=True,
@@ -398,22 +792,15 @@ class LineServer:
                 conn.close()
             except OSError:
                 pass
-            with self._conns_lock:
-                try:
-                    self._conns.remove(conn)
-                except ValueError:
-                    pass
-                self._conn_stats.pop(conn, None)
+            self._forget_conn(conn)
 
     # -- override points ---------------------------------------------------
     def handle_connection(self, conn: socket.socket) -> None:
-        """Default: the persistent line loop — reassemble newline-framed
-        requests, answer each with ``respond(line) + "\\n"`` in order.
-        A request exceeding ``max_line_bytes`` with no newline gets one
-        ``err bad-request`` line and the connection closed (the buffer
-        must stay bounded).  Bytes and frames are attributed per line
-        to the request's verb (wire accounting — see module
-        docstring)."""
+        """Full-socket override point (telemetry exporter, chaos
+        proxy).  Subclasses overriding this run under the legacy
+        accept loop; the default implementation is the old blocking
+        line loop, kept for completeness but unused by the event-loop
+        path."""
         buf = b""
         stats = self._stats_for(conn)
         while not self._stop.is_set():
@@ -437,9 +824,6 @@ class LineServer:
                 resp = self.respond(line)
                 if resp is not None:
                     payload = resp.encode("utf-8") + b"\n"
-                    # ledger BEFORE the write: a client that has read
-                    # the response must never observe a table that
-                    # hasn't counted it yet
                     stats.bytes_out += len(payload)
                     stats.frames_out += 1
                     self.meter.count("out", verb, len(payload))
@@ -452,6 +836,20 @@ class LineServer:
         raise NotImplementedError(
             f"{type(self).__name__} must implement respond() or override "
             f"handle_connection()"
+        )
+
+    def respond_frame(self, data: bytes) -> Optional[bytes]:
+        """One encoded response frame per binary request frame
+        (utils/frames.py).  The default declines: a server that never
+        answered the binary hello should never see one of these — and
+        if it does, the error frame tells the peer to downgrade."""
+        try:
+            verb, _enc, _flag, _total = binframes.peek_header(data)
+        except binframes.FrameError:
+            verb = 0
+        return binframes.error_response(
+            verb, binframes.STATUS_BAD_REQUEST,
+            "binary frames not supported",
         )
 
 

@@ -427,18 +427,28 @@ class TestMigration:
 
 
 class _SlowOnceServer(ShardServer):
-    """Delays exactly one pull frame (the straggler injection)."""
+    """Delays exactly one pull frame (the straggler injection) —
+    hooked on BOTH framings (clients negotiate binary by default)."""
 
     def __init__(self, *a, **k):
         super().__init__(*a, **k)
         self.slow = threading.Event()
         self.delay_s = 0.5
 
-    def respond(self, line):
-        if line.startswith("pull") and self.slow.is_set():
+    def _maybe_stall(self, verb: str) -> None:
+        if verb == "pull" and self.slow.is_set():
             self.slow.clear()
             time.sleep(self.delay_s)
+
+    def respond(self, line):
+        self._maybe_stall(line.split(None, 1)[0].lower() if line else "")
         return super().respond(line)
+
+    def respond_frame(self, data):
+        from flink_parameter_server_tpu.utils import frames as wire
+
+        self._maybe_stall(wire.peek_verb_name(data))
+        return super().respond_frame(data)
 
 
 class TestHedging:
@@ -880,11 +890,11 @@ class _Echo(LineServer):
 
 
 def test_lineserver_stop_joins_handler_threads():
-    """utils/net satellite: stop() joins the per-connection handler
-    threads — including one still BLOCKED in recv on an open client
-    connection — so repeated scale-in/out cycles in one process don't
-    leak a thread (and its socket buffers) per connection ever
-    accepted."""
+    """utils/net satellite: stop() joins the per-connection dispatcher
+    threads — including one still BLOCKED in its linger-recv on an
+    open client connection (the event-loop fast path) — so repeated
+    scale-in/out cycles in one process don't leak a thread (and its
+    socket buffers) per connection ever accepted."""
     import socket as socket_mod
 
     for _ in range(5):
@@ -893,17 +903,19 @@ def test_lineserver_stop_joins_handler_threads():
             assert request_lines(
                 srv.host, srv.port, ["ping"]
             ) == ["ok ping"]
-        # one connection left OPEN: its handler sits in recv() when
-        # stop() runs — exactly the lingering-thread case
+        # one ACTIVE connection left open: after answering, its
+        # dispatcher lingers in recv() when stop() runs — exactly the
+        # blocked-thread case (a never-written connection costs no
+        # thread at all under the selectors loop — that's the point)
         idle = socket_mod.create_connection((srv.host, srv.port))
-        # wait for the idle connection's handler to be LIVE (finished
-        # handlers from the pings above may linger in the list)
+        idle.sendall(b"ping\n")
+        assert idle.recv(1 << 12) == b"ok ping\n"
         deadline = time.monotonic() + 5
         live = []
         while not live and time.monotonic() < deadline:
             live = [t for t in srv._handlers if t.is_alive()]
             time.sleep(0.002)
-        assert live, "handler thread never spawned"
+        assert live, "dispatcher thread never spawned"
         srv.stop()
         # stop() joined what it saw; a handler registered concurrently
         # with the shutdown exits on the stop flag — grace-wait, then

@@ -245,7 +245,8 @@ class TestRealTree:
         """The live shard verb set is exactly what docs/cluster.md
         documents — the migration xfer/load family, the psctl conns
         verb (the PR-8 drift fix), the replica-chain repl/replstate
-        stream (PR 9), and the hot-key lease grant plane (PR 11)."""
+        stream (PR 9), the hot-key lease grant plane (PR 11), and the
+        binary-framing hello negotiation (PR 13)."""
         from tools.fpsanalyze.astindex import Index
         from tools.fpsanalyze.cli import _collect_files
         from tools.fpsanalyze.rules_drift import (
@@ -263,8 +264,8 @@ class TestRealTree:
             ROOT, "docs/cluster.md", "wire-verbs shard"
         )
         assert handled == {
-            "pull", "push", "lease", "revoke", "xfer", "load", "repl",
-            "replstate", "flush", "stats", "conns",
+            "hello", "pull", "push", "lease", "revoke", "xfer", "load",
+            "repl", "replstate", "flush", "stats", "conns",
         }
         assert documented == handled
 

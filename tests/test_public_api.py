@@ -113,7 +113,12 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.cluster": [
         "ClusterClient", "ClusterConfig", "ClusterDriver",
         "ConsistentHashPartitioner", "RangePartitioner", "ParamShard",
-        "ShardServer", "StalenessClock", "StaleEpoch", "FrozenKeys"],
+        "ShardServer", "StalenessClock", "StaleEpoch", "FrozenKeys",
+        "ShardProcess", "ShardProcSpec"],
+    "flink_parameter_server_tpu.utils.frames": [
+        "Frame", "FrameError", "encode_request", "encode_response",
+        "decode", "rows_to_payload", "rows_from_payload",
+        "HELLO_LINE", "VERB_IDS"],
     "flink_parameter_server_tpu.elastic": [
         "ElasticClusterConfig", "ElasticClusterDriver",
         "ElasticController", "ScalePolicy", "MembershipService",
@@ -126,7 +131,8 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.replication.failover": [
         "salvage_records", "verify_against_log"],
     "flink_parameter_server_tpu.resilience.wal": [
-        "UpdateWAL", "WALRecord", "encode_frame", "decode_frame"],
+        "UpdateWAL", "WALRecord", "encode_frame", "decode_frame",
+        "encode_frame_bytes", "decode_frame_bytes"],
     "flink_parameter_server_tpu.serving.follower": [
         "FollowerLookupService", "ChainLookupResult"],
     "flink_parameter_server_tpu.data.movielens": [

@@ -330,18 +330,30 @@ def test_e2e_hedged_pull_trace_spans_process_lanes(tmp_path):
     )
     stop_serving = threading.Event()
     with driver:
-        # chaos straggler: shard 0's server delays exactly one pull
+        # chaos straggler: shard 0's server delays exactly one pull —
+        # hooked on BOTH framings (clients negotiate binary by default)
         victim = driver.servers[0]
         orig_respond = victim.respond
+        orig_respond_frame = victim.respond_frame
         armed = {"on": True}
 
-        def slow_respond(line):
-            if line.startswith("pull") and armed["on"]:
+        def _stall(verb):
+            if verb == "pull" and armed["on"]:
                 armed["on"] = False
                 time.sleep(0.3)
+
+        def slow_respond(line):
+            _stall(line.split(None, 1)[0].lower() if line else "")
             return orig_respond(line)
 
+        def slow_respond_frame(data):
+            from flink_parameter_server_tpu.utils import frames as wire
+
+            _stall(wire.peek_verb_name(data))
+            return orig_respond_frame(data)
+
         victim.respond = slow_respond
+        victim.respond_frame = slow_respond_frame
 
         # the "serve" side: concurrent reads through their own client
         serve_client = driver._make_client(worker="serve")

@@ -108,6 +108,16 @@ def load_ledger(repo: str) -> Dict[str, Dict[str, Tuple[float, str]]]:
         ent = _entry(doc.get("payload", doc))
         if ent is not None:
             note(ent[0], CURRENT, ent[1], ent[2])
+        # A/B artifacts carry SEVERAL metric-shaped payloads (e.g.
+        # results/cpu/transport_ab.json: one per arm + the headline
+        # shares) — fold each so regressions in either arm, or in the
+        # speedup itself, flag in the worse direction
+        payloads = doc.get("payloads")
+        if isinstance(payloads, list):
+            for p in payloads:
+                ent = _entry(p)
+                if ent is not None:
+                    note(ent[0], CURRENT, ent[1], ent[2])
     return ledger
 
 

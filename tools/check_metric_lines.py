@@ -195,6 +195,27 @@ def check_flightrec(doc: Any) -> List[str]:
     return bad
 
 
+# the canonical phase vocabulary of one cluster round — kept in
+# LOCKSTEP with telemetry/profiler.PHASES (a test pins the pair, same
+# idiom as the nemesis corpus pin) so a transport rework that renames
+# or adds a phase must update the lint AND the docs together.  The
+# binary transport (utils/frames.py) reuses these names — the phases
+# are transport-generic costs (frame encode IS client_serialize), and
+# one vocabulary is what keeps the line-vs-binary A/B directly
+# comparable (results/cpu/transport_ab.md).
+KNOWN_BUDGET_PHASES = frozenset({
+    "client_serialize",
+    "wire",
+    "server_queue_wait",
+    "server_parse",
+    "wal_append",
+    "scatter_apply",
+    "response_serialize",
+    "server_other",
+    "client_parse",
+})
+
+
 def check_budget(doc: Any) -> List[str]:
     """Lint a latency-budget artifact (telemetry/profiler.py
     ``write_budget_artifact`` format)."""
@@ -224,6 +245,13 @@ def check_budget(doc: Any) -> List[str]:
             ):
                 bad.append(f"budget {verb!r}: phase without a name")
                 continue
+            if p["phase"] not in KNOWN_BUDGET_PHASES:
+                bad.append(
+                    f"budget {verb!r}: unknown phase {p['phase']!r} "
+                    f"(not in the canonical vocabulary — update "
+                    f"KNOWN_BUDGET_PHASES + telemetry/profiler.PHASES "
+                    f"together)"
+                )
             for field in ("p50_ms", "pct"):
                 v = p.get(field)
                 if not isinstance(v, (int, float)) or v < 0:

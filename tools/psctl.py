@@ -308,6 +308,10 @@ def cmd_conns(args) -> int:
         print(f"{addr}: {len(conns)} connection(s)")
         rows = [
             [c.get("peer", "?"), f"{c.get('age_s', 0):.1f}s",
+             # negotiated framing + last payload encoding: the two
+             # columns that make a mixed line/binary fleet visible
+             # mid-rollout (utils/net.py ConnStats)
+             c.get("proto", "line"), c.get("enc", "") or "-",
              _fmt_bytes(c.get("bytes_in", 0)),
              _fmt_bytes(c.get("bytes_out", 0)),
              str(c.get("frames_in", 0)), str(c.get("frames_out", 0)),
@@ -316,8 +320,8 @@ def cmd_conns(args) -> int:
         ]
         if rows:
             print(_render_table(
-                ["peer", "age", "bytes in", "bytes out", "frames in",
-                 "frames out", "last verb"],
+                ["peer", "age", "proto", "enc", "bytes in",
+                 "bytes out", "frames in", "frames out", "last verb"],
                 rows,
             ))
     return 0
