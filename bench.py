@@ -1119,6 +1119,59 @@ def _emit_soak_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_compression_metric(platform: str, fallback: bool) -> None:
+    """Eleventh (opt-in) metric line: the quantized push path A/B.
+
+    FPS_BENCH_COMPRESSION=1 runs benchmarks/compression_ab.py — the
+    fp32-vs-q8 push codec A/B over bandwidth-capped links, the
+    aggregation-tree A/B, the replication-leg catch-up on the same
+    log, and the BSP bitwise carve-out pin — and writes
+    ``results/<platform>/compression_ab.{md,json}``, the artifact any
+    bytes-on-wire claim must cite (docs/compression.md).  Default 0
+    (the A/B costs tens of seconds); failure degrades to a value-None
+    line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_COMPRESSION", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_COMPRESSION={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "compression push bytes ratio (fp32/q8, equal RMSE)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.compression_ab import run_compression_bench
+
+        r = run_compression_bench()
+        q8, f32 = r["push"]["q8"], r["push"]["f32"]
+        print(json.dumps({
+            "metric": metric,
+            "value": r["push_bytes_ratio"],
+            "unit": "x (higher is better)",
+            "extra": {
+                "push_bytes_per_round_f32": f32["push_bytes_per_round"],
+                "push_bytes_per_round_q8": q8["push_bytes_per_round"],
+                "push_p99_ms_f32": f32["push_p99_ms"],
+                "push_p99_ms_q8": q8["push_p99_ms"],
+                "rel_rmse_q8": q8["rel_rmse_vs_oracle"],
+                "rel_rmse_f32": f32["rel_rmse_vs_oracle"],
+                "bsp_bitwise": r["bsp_bitwise"],
+                "aggregation_frames_ratio":
+                    r["aggregation"]["frames_ratio"],
+                "repl_catch_up_ratio":
+                    r["replication"]["catch_up_ratio"],
+                "repl_bytes_ratio": r["replication"]["bytes_ratio"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "x (higher is better)",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1150,6 +1203,7 @@ def main():
             _emit_nemesis_metric(platform, fallback)
             _emit_hotcache_metric(platform, fallback)
             _emit_soak_metric(platform, fallback)
+            _emit_compression_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1208,6 +1262,7 @@ def main():
     _emit_nemesis_metric(platform, fallback)
     _emit_hotcache_metric(platform, fallback)
     _emit_soak_metric(platform, fallback)
+    _emit_compression_metric(platform, fallback)
 
 
 if __name__ == "__main__":

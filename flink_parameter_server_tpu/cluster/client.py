@@ -198,6 +198,11 @@ class ShardConnection:
         self.inflight = 0
         self.requests_sent = 0
         self.proto = "line"
+        # quantized encodings the peer advertised on its hello answer
+        # (frames.hello_encs): empty until negotiated; a bin server
+        # without the enc= token is assumed bf16-only (PR-13 era) and
+        # q8 frames downgrade to exact f32 on this connection
+        self.encs: frozenset = frozenset()
         # client-role wire ledger (utils/net.py): bytes/frames per
         # verb, each direction — the other endpoint of the shard
         # servers' accounting
@@ -213,6 +218,7 @@ class ShardConnection:
         resp = self.request_many([binf.HELLO_LINE])[0]
         if isinstance(resp, str) and resp.startswith("ok proto=bin"):
             self.proto = "bin"
+            self.encs = binf.hello_encs(resp)
 
     def _read_exact(self, n: int, what: str) -> bytes:
         """Exactly ``n`` bytes off the buffered reader, or
@@ -591,9 +597,10 @@ class ClusterClient(ParameterServerClient):
             self._replicas = [tuple(r) for r in view.replicas]
         if chunk < 1:
             raise ValueError(f"chunk={chunk}: must be >= 1")
-        if wire_format not in ("text", "b64", "bf16"):
+        if wire_format not in ("text", "b64", "bf16", "q8"):
             raise ValueError(
-                f"wire_format={wire_format!r}: 'text' | 'b64' | 'bf16'"
+                f"wire_format={wire_format!r}: "
+                f"'text' | 'b64' | 'bf16' | 'q8'"
             )
         if wire_proto not in ("auto", "line"):
             raise ValueError(
@@ -752,6 +759,30 @@ class ClusterClient(ParameterServerClient):
             NULL_PROFILER if registry is False and profiler is None
             else resolve_profiler(profiler)
         )
+        # quantized delta push path (compression/, docs/compression.md):
+        # wire_format "q8"/"bf16" routes every push through an
+        # error-feedback DeltaCompressor — the table ALWAYS receives
+        # exactly the dequantized rows, over any framing (q8/bf16
+        # frames on advertising peers, exact f32 on old ones), so
+        # replays, re-routes and mixed fleets stay deterministic and
+        # the exactly-once ledger balances.  BSP carve-out is the
+        # DRIVER's job (bound-0 worker clients are built with "b64").
+        self._compressor = None
+        self._c_bytes_saved = None
+        if wire_format in ("q8", "bf16"):
+            from ..compression.quantizers import DeltaCompressor
+
+            self._compressor = DeltaCompressor(wire_format)
+            if self._reg is not None:
+                self._c_bytes_saved = self._reg.counter(
+                    "compression_bytes_saved_total",
+                    component="compression", **self._labels,
+                )
+                self._reg.gauge(
+                    "compression_residual_norm",
+                    component="compression",
+                    fn=self._compressor.residuals.norm, **self._labels,
+                )
 
     # -- hot-key lease cache (hotcache/, docs/hotcache.md) --------------------
     def attach_hotcache(
@@ -1066,6 +1097,16 @@ class ClusterClient(ParameterServerClient):
             (ids_arr.size if mask is None else int(np.asarray(mask).sum()))
             - unique.size
         )
+        # quantize ONCE per logical batch (error feedback applied here,
+        # never in a retry path): the delivered rows are the
+        # dequantized values, identical over every framing and every
+        # replay — the q sections are sliced per shard below
+        q_rows = q_scales = None
+        if self._compressor is not None:
+            summed, q_rows, q_scales = self._compressor.compress(
+                unique, summed
+            )
+            summed = summed.astype(np.float32)
         # one pid per logical batch: (pid, id) identifies each row-push
         # uniquely (unique is deduped), stable across replays/re-routes
         pid = (
@@ -1087,8 +1128,18 @@ class ClusterClient(ParameterServerClient):
 
                 def do(s, sids):
                     rows = todo_rows[np.searchsorted(todo_ids, sids)]
+                    qr = qs = None
+                    if q_rows is not None:
+                        # unique is sorted and every retry set is a
+                        # subset of it, so the q sections slice by the
+                        # same positional lookup on any replay round
+                        pos = np.searchsorted(unique, sids)
+                        qr, qs = q_rows[pos], q_scales[pos]
                     try:
-                        self._push_shard(s, sids, rows, pid, ctx)
+                        self._push_shard(
+                            s, sids, rows, pid, ctx, q_rows=qr,
+                            q_scales=qs,
+                        )
                     except _Rejected as r:
                         with rej_lock:
                             rejected.append(r.ids)
@@ -1537,8 +1588,11 @@ class ClusterClient(ParameterServerClient):
         return hot_out, cold_out
 
     def _bin_enc(self) -> int:
-        """Row encoding for binary frames: exact fp32 unless the
-        client opted into bf16 (half the row bytes, lossy)."""
+        """Row encoding for binary READ frames (pull/lease answers):
+        exact fp32 unless the client opted into bf16 (half the row
+        bytes, lossy).  ``q8`` is a PUSH-delta codec only — absolute
+        values carry no residual to re-inject, so quantizing reads
+        would be silent corruption (docs/compression.md)."""
         return (
             binf.ENC_BF16 if self.wire_format == "bf16"
             else binf.ENC_F32
@@ -1681,6 +1735,8 @@ class ClusterClient(ParameterServerClient):
         deltas: np.ndarray,
         pid: Optional[str] = None,
         ctx=None,
+        q_rows: Optional[np.ndarray] = None,
+        q_scales: Optional[np.ndarray] = None,
     ) -> None:
         prof = self._profiler
         tok, span_cm, _span_id = self._frame_trace(shard, "push", ctx)
@@ -1693,8 +1749,41 @@ class ClusterClient(ParameterServerClient):
         def build(conn) -> List:
             t_ser = time.perf_counter()
             if conn.proto == "bin":
-                enc = self._bin_enc()
                 tlvs = self._bin_tlvs(tok, pid)
+                if q_rows is not None and "q8" in conn.encs:
+                    # the quantized push path: int8 rows + a T_SCALE
+                    # TLV of the per-row f32 scales, per chunk.  The
+                    # rows the shard will apply are bitwise the
+                    # `deltas` (dq) rows — only the bytes differ.
+                    reqs = []
+                    saved = 0
+                    for i in range(0, len(ids), self.chunk):
+                        qc = np.ascontiguousarray(
+                            q_rows[i: i + self.chunk]
+                        )
+                        sc = np.ascontiguousarray(
+                            q_scales[i: i + self.chunk], "<f4"
+                        )
+                        reqs.append(binf.encode_request(
+                            binf.VERB_IDS["push"],
+                            ids=ids[i: i + self.chunk],
+                            payload=qc.tobytes(),
+                            enc=binf.ENC_Q8, epoch=self._epoch,
+                            priority=self._priority,
+                            tlvs=[(binf.T_SCALE, sc.tobytes())] + tlvs,
+                        ))
+                        saved += 3 * qc.size - sc.nbytes
+                    if self._c_bytes_saved is not None and saved > 0:
+                        self._c_bytes_saved.inc(saved)
+                    ser_cell[0] = (
+                        (time.perf_counter() - t_ser)
+                        / max(1, len(reqs))
+                    )
+                    return reqs
+                enc = (
+                    binf.ENC_BF16 if self.wire_format == "bf16"
+                    else binf.ENC_F32
+                )
                 reqs = [
                     binf.encode_request(
                         binf.VERB_IDS["push"],
@@ -1707,6 +1796,14 @@ class ClusterClient(ParameterServerClient):
                     )
                     for i in range(0, len(ids), self.chunk)
                 ]
+                if (
+                    enc == binf.ENC_BF16
+                    and self._c_bytes_saved is not None
+                ):
+                    # bf16 halves the row bytes vs f32
+                    self._c_bytes_saved.inc(
+                        2 * int(np.asarray(deltas).size)
+                    )
             else:
                 suffix = self._frame_suffix(pid) + (
                     " t=" + tok if tok is not None else ""

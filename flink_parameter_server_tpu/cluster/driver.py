@@ -69,11 +69,21 @@ class ClusterConfig:
     worker_key: str = "user"
     # client knobs: pipelining window (outstanding frames per shard
     # connection), ids per frame, payload encoding (shard.py: "b64"
-    # exact+fast, "text" exact+debuggable, "bf16" half-bytes lossy —
-    # binary framing only)
+    # exact+fast, "text" exact+debuggable, "bf16" half-bytes +
+    # error-feedback residuals, "q8" per-row-scaled int8 deltas +
+    # residuals — compression/, docs/compression.md).  BSP carve-out:
+    # bound-0 WORKER clients always get exact fp32 regardless (a
+    # quantized write would break read-your-last-round bitwise parity;
+    # enforced in _make_client, the same discipline as hot_cache).
     window: int = 8
     chunk: int = 512
     wire_format: str = "b64"
+    # two-level aggregation tree (compression/aggregator.py): workers
+    # rendezvous per round and a combiner issues ONE merged push per
+    # shard (its own client, its own pid space — the exactly-once
+    # ledger balances on the uplink).  Trades per-round lockstep on
+    # the PUSH side for a num_workers× cut in push frames.
+    push_aggregate: bool = False
     # transport framing (utils/frames.py, docs/cluster.md "Binary
     # framing"): "auto" negotiates the length-prefixed binary frame
     # per connection (one hello round trip; old servers answer err
@@ -346,6 +356,14 @@ class ClusterDriver:
 
     def _make_client(self, worker: Optional[str] = None) -> ClusterClient:
         cfg = self.config
+        # BSP carve-out (docs/compression.md): a bound-0 worker's reads
+        # must see every previous-round write bitwise, so quantized
+        # delta encodings downgrade to exact fp32 here — parity is
+        # pinned in tests/test_compression.py, the same enforcement
+        # point as the hot-cache bypass below
+        wire_format = cfg.wire_format
+        if cfg.staleness_bound == 0 and wire_format in ("q8", "bf16"):
+            wire_format = "b64"
         client = ClusterClient(
             [(srv.host, srv.port) for srv in self.servers],
             self.partitioner,
@@ -354,7 +372,7 @@ class ClusterDriver:
             chunk=cfg.chunk,
             timeout=cfg.request_timeout,
             connect_timeout=cfg.connect_timeout,
-            wire_format=cfg.wire_format,
+            wire_format=wire_format,
             wire_proto=cfg.wire_proto,
             spawn_grace_s=(
                 cfg.spawn_grace_s if cfg.shard_procs else 0.0
@@ -505,6 +523,22 @@ class ClusterDriver:
             if cfg.staleness_bound == 0 and cfg.num_workers > 1
             else None
         )
+        # aggregation tree (compression/aggregator.py): one combiner
+        # uplink per run, workers rendezvous per round and the shards
+        # see ONE merged push — fresh per run (a broken barrier must
+        # not leak into the next job)
+        push_agg = None
+        if cfg.push_aggregate and cfg.num_workers > 1:
+            from ..compression.aggregator import PushAggregator
+
+            push_agg = PushAggregator(
+                cfg.num_workers,
+                self._make_client(worker="combiner"),
+                registry=self.registry,
+                timeout=timeout,
+            )
+        # exposed for post-run ledger audits (rows the uplink acked)
+        self.last_push_aggregator = push_agg
         errors: List[BaseException] = []
         states: List[Any] = [None] * cfg.num_workers
         outputs: List[List[Any]] = [[] for _ in range(cfg.num_workers)]
@@ -545,10 +579,16 @@ class ClusterDriver:
                     req_mask = (
                         None if req.mask is None else np.asarray(req.mask)
                     )
-                    client.push_batch(
-                        np.asarray(req.ids), np.asarray(req.deltas),
-                        req_mask,
-                    )
+                    if push_agg is not None:
+                        push_agg.push_batch(
+                            w, np.asarray(req.ids),
+                            np.asarray(req.deltas), req_mask,
+                        )
+                    else:
+                        client.push_batch(
+                            np.asarray(req.ids), np.asarray(req.deltas),
+                            req_mask,
+                        )
                     clock.tick(w)
                     events[w] += int(wb["mask"].sum())
                     if c_rounds is not None:
@@ -560,6 +600,10 @@ class ClusterDriver:
                 errors.append(e)
                 if pull_barrier is not None:
                     pull_barrier.abort()
+                if push_agg is not None:
+                    # siblings parked at the push rendezvous must get
+                    # BrokenBarrierError, not a hang
+                    push_agg.abort()
             finally:
                 clock.deactivate(w)
 
@@ -576,6 +620,8 @@ class ClusterDriver:
         for t in threads:
             t.join(timeout=timeout)
         wall = time.perf_counter() - t0
+        if push_agg is not None:
+            push_agg.close()
         if errors:
             raise errors[0]
         return ClusterResult(

@@ -490,7 +490,13 @@ class ParamShard:
                 )
             else:
                 ids = np.asarray(p["ids"], np.int64)
-                self._apply(ids, np.asarray(p["deltas"], np.float32))
+                # record_deltas: plain f32 records and quantized
+                # (qdeltas+scales) records — a promoted follower's log
+                # holds the latter when its leg shipped compressed
+                # (compression/quantizers.py) — replay identically
+                from ..compression.quantizers import record_deltas
+
+                self._apply(ids, record_deltas(p))
                 if p.get("pid") is not None:
                     self._remember_pairs(p["pid"], ids)
             self._push_seq = rec.end_step
@@ -1304,7 +1310,11 @@ class ShardServer(LineServer):
             # — and the client stays on the line protocol: the PR-6
             # versioning contract covering the whole framing.
             if len(toks) >= 2 and toks[1].lower() == "bin":
-                return binf.HELLO_OK
+                # the answer advertises the quantized-encoding
+                # vocabulary (enc=bf16,q8 — docs/compression.md): old
+                # clients check the "ok proto=bin" prefix only, new
+                # clients downgrade unadvertised encodings to f32
+                return binf.hello_ok_line()
             raise ValueError(
                 f"unknown protocol {' '.join(toks[1:])!r} (try: bin)"
             )
@@ -1605,9 +1615,22 @@ class ShardServer(LineServer):
         if verb == binf.VERB_IDS["push"]:
             with self.profiler.timer("push", "server_parse"):
                 ids = self._frame_ids(req)
-                deltas = binf.rows_from_payload(
-                    req.payload, shard.value_shape, req.enc
-                )
+                if req.enc == binf.ENC_Q8:
+                    # per-row-scaled int8 deltas (the quantized push
+                    # path, docs/compression.md): int8 payload + f32
+                    # scales in the T_SCALE TLV, dequantized host-side
+                    # — the applied rows are exactly the dq values the
+                    # client computed its residual against
+                    from ..compression.quantizers import q8_from_payload
+
+                    deltas = q8_from_payload(
+                        req.payload, req.tlvs.get(binf.T_SCALE),
+                        shard.value_shape,
+                    )
+                else:
+                    deltas = binf.rows_from_payload(
+                        req.payload, shard.value_shape, req.enc
+                    )
             if len(deltas) != len(ids):
                 raise ValueError(
                     f"{len(ids)} ids but {len(deltas)} delta rows"

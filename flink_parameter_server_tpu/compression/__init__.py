@@ -1,0 +1,49 @@
+"""compression/ — quantized delta push path + hierarchical aggregation.
+
+ROADMAP item 3, docs/compression.md: wire-level delta quantization
+(per-row-scaled int8 + bf16) with host-side error-feedback residuals,
+and the two-level aggregation tree combining co-located workers'
+deltas into one push per shard per round.
+
+Import discipline: the codec surface below is numpy-only — shard
+worker PROCESSES (cluster/procs.py) decode ``ENC_Q8`` frames through
+this package and must never pay a jax import for it.
+:class:`PushAggregator` (which leans on ``ops/dedup`` and therefore
+jax) is loaded lazily on first attribute access.
+"""
+from .quantizers import (
+    BF16,
+    Q8,
+    DeltaCompressor,
+    ResidualStore,
+    bf16_roundtrip,
+    compress_record_payload,
+    dequantize_q8,
+    q8_from_payload,
+    q8_payload,
+    quantize_q8,
+    record_deltas,
+)
+
+__all__ = [
+    "BF16",
+    "DeltaCompressor",
+    "PushAggregator",
+    "Q8",
+    "ResidualStore",
+    "bf16_roundtrip",
+    "compress_record_payload",
+    "dequantize_q8",
+    "q8_from_payload",
+    "q8_payload",
+    "quantize_q8",
+    "record_deltas",
+]
+
+
+def __getattr__(name):  # PEP 562 — keeps the codec path jax-free
+    if name == "PushAggregator":
+        from .aggregator import PushAggregator
+
+        return PushAggregator
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -294,9 +294,13 @@ def execute_moves(
                                 payload["values"], np.float32
                             )[ok][fresh]
                         else:
-                            rows[rows_idx] = rows[rows_idx] + np.asarray(
-                                payload["deltas"], np.float32
-                            )[ok][fresh]
+                            from ..compression.quantizers import (
+                                record_deltas,
+                            )
+
+                            rows[rows_idx] = rows[rows_idx] + (
+                                record_deltas(payload)[ok][fresh]
+                            )
                         touched[rows_idx] = True
                     if touched.any():
                         _load_rows(

@@ -195,6 +195,7 @@ def _build_driver(s: Scenario, init, wal_dir: str, registry):
         num_workers=s.num_workers,
         staleness_bound=s.staleness_bound,
         wal_dir=wal_dir,
+        wire_format=s.wire_format,
         request_timeout=s.request_timeout,
         retry_timeout=s.retry_timeout,
         connect_timeout=2.0,

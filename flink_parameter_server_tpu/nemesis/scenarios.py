@@ -130,6 +130,13 @@ class Scenario:
     # through whatever the schedule does to the wire.  Workers stay
     # BSP-uncached (the carve-out), so parity remains meaningful.
     hotcache: bool = False
+    # client payload encoding (cluster/driver.py ClusterConfig):
+    # "b64" = the exact default; "q8"/"bf16" replay the schedule over
+    # QUANTIZED-enc connections (compression/, docs/compression.md) —
+    # the torn-quantized-frame regression rides this field.  BSP
+    # scenarios keep parity either way (the driver's bound-0 carve-out
+    # downgrades worker clients to exact fp32).
+    wire_format: str = "b64"
     request_timeout: float = 15.0
     retry_timeout: float = 60.0
     expect: str = "pass"

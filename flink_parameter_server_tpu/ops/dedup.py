@@ -104,9 +104,45 @@ def aggregate_deltas(
     return unique.astype(np.int64), out.astype(flat_d.dtype)
 
 
+def aggregate_delta_batches(batches) -> Tuple[np.ndarray, np.ndarray]:
+    """:func:`aggregate_deltas` across SEVERAL workers' batches — the
+    aggregation tree's combine step (compression/aggregator.py): each
+    element of ``batches`` is ``(ids, deltas)`` or ``(ids, deltas,
+    mask)``; the result is one ``(unique_ids, summed)`` pair equal to
+    aggregating the concatenation (per-id sums are associative — the
+    f64 accumulator below makes the combine order immaterial).  Empty
+    or ``None`` entries are skipped, so a worker with nothing to push
+    this round costs nothing."""
+    flat_ids = []
+    flat_deltas = []
+    for entry in batches:
+        if entry is None:
+            continue
+        ids, deltas = entry[0], entry[1]
+        mask = entry[2] if len(entry) > 2 else None
+        ids_arr = np.asarray(ids).reshape(-1).astype(np.int64)
+        if ids_arr.size == 0:
+            continue
+        d = np.asarray(deltas)
+        d = d.reshape((ids_arr.size,) + d.shape[np.asarray(ids).ndim:])
+        if mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            ids_arr, d = ids_arr[m], d[m]
+            if ids_arr.size == 0:
+                continue
+        flat_ids.append(ids_arr)
+        flat_deltas.append(d)
+    if not flat_ids:
+        return np.empty(0, np.int64), np.empty(0, np.float32)
+    all_ids = np.concatenate(flat_ids)
+    all_deltas = np.concatenate(flat_deltas)
+    return aggregate_deltas(all_ids, all_deltas)
+
+
 __all__ = [
     "occurrence_counts",
     "occurrence_scale",
     "coalesce_ids",
     "aggregate_deltas",
+    "aggregate_delta_batches",
 ]

@@ -90,11 +90,17 @@ class ChainManager:
         request_timeout: float = 5.0,
         heartbeat_interval_s: float = 0.05,
         heartbeat_timeout_s: float = 0.5,
+        repl_enc: str = "f32",
     ):
         if replication_factor < 1:
             raise ValueError(
                 f"replication_factor={replication_factor}: must be >= 1"
             )
+        # per-leg delta encoding (compression/, docs/compression.md):
+        # "q8" ships quantized push records with per-leg error-feedback
+        # residuals — follower within one granule per id, ~4× fewer
+        # delta bytes; "f32" (default) keeps the bitwise contract
+        self.repl_enc = str(repl_enc)
         self.driver = driver
         self.replication_factor = int(replication_factor)
         self.staleness_bound = staleness_bound
@@ -165,6 +171,7 @@ class ChainManager:
                 fault_hook=self._fault_hook,
                 connect_timeout=self._connect_timeout,
                 timeout=self._request_timeout,
+                enc=self.repl_enc,
             ).start()
             followers.append(f)
             servers.append(srv)

@@ -58,6 +58,15 @@ class ReplicatedClusterConfig(ElasticClusterConfig):
     worker_read_replicas: Optional[bool] = None
     # chaos injection point for the repl stream (FaultPlan.shipper_hook)
     repl_fault_hook: Optional[Callable[[int], Optional[str]]] = None
+    # delta encoding of the repl stream (compression/quantizers.py,
+    # docs/compression.md): "f32" ships bitwise records (default —
+    # the caught-up follower is bitwise the primary); "q8" ships
+    # per-row-scaled int8 deltas with per-leg error-feedback residuals
+    # — the follower tracks within one quantization granule per id and
+    # the stream carries ~4× fewer delta bytes (the replication-lag
+    # win on bandwidth-constrained legs).  Loads and epoch snapshots
+    # always ship bitwise.
+    repl_wire_format: str = "f32"
 
 
 class ReplicatedClusterDriver(ElasticClusterDriver):
@@ -129,6 +138,7 @@ class ReplicatedClusterDriver(ElasticClusterDriver):
             request_timeout=cfg.repl_request_timeout,
             heartbeat_interval_s=cfg.heartbeat_interval_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+            repl_enc=cfg.repl_wire_format,
         )
         self.chains.build_all()
         self.membership = MembershipService(

@@ -125,9 +125,13 @@ def verify_against_log(shard) -> bool:
                 np.asarray(p["values"], np.float32),
             )
         else:
+            from ..compression.quantizers import record_deltas
+
+            # quantized records (a q8 replication leg) replay through
+            # the same decode seam the applier used — deterministic
+            # dequantization keeps the audit bitwise either way
             scratch._apply(
-                np.asarray(p["ids"], np.int64),
-                np.asarray(p["deltas"], np.float32),
+                np.asarray(p["ids"], np.int64), record_deltas(p)
             )
     return bool(np.array_equal(scratch.values(), live))
 

@@ -198,8 +198,14 @@ class ReplicaShard(ParamShard):
                 np.asarray(p["values"], np.float32),
             )
         else:
+            from ..compression.quantizers import record_deltas
+
             ids = np.asarray(p["ids"], np.int64)
-            self._apply(ids, np.asarray(p["deltas"], np.float32))
+            # record_deltas: exact f32 records and quantized ones (a
+            # q8 leg ships qdeltas+scales — compression/) decode
+            # through one seam, so the applier, promotion replay and
+            # the verify-against-log audit all see identical rows
+            self._apply(ids, record_deltas(p))
             if p.get("pid") is not None:
                 self._remember_pairs(p["pid"], ids)
         self._push_seq = rec.end_step

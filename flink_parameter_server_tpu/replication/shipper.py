@@ -123,17 +123,41 @@ class WALShipper:
         timeout: float = 5.0,
         idle_wait_s: float = 0.05,
         retry_backoff_s: float = 0.02,
+        enc: str = "f32",
     ):
+        if enc not in ("f32", "q8"):
+            raise ValueError(f"enc={enc!r}: 'f32' | 'q8'")
         self.primary = primary
         self.follower_addr = tuple(follower_addr)
         self._queue = queue
         self.follower_idx = int(follower_idx)
+        # quantized replication (compression/, docs/compression.md):
+        # enc="q8" rewrites each shipped push record's deltas to
+        # per-row-scaled int8 with a PER-LEG error-feedback residual —
+        # the follower's log and table then track the primary within
+        # one quantization granule per id instead of bitwise (the
+        # documented trade for ~4× fewer delta bytes on the stream).
+        # Loads/snapshots stay bitwise; default "f32" ships exact.
+        self.enc = enc
+        self._compressor = None
+        self.repl_bytes_saved = 0
+        if enc == "q8":
+            from ..compression.quantizers import DeltaCompressor
+
+            self._compressor = DeltaCompressor("q8")
         self._fault_hook = fault_hook
         self._connect_timeout = float(connect_timeout)
         self._timeout = float(timeout)
         self._idle_wait_s = float(idle_wait_s)
         self._retry_backoff_s = float(retry_backoff_s)
         self._lock = threading.Lock()
+        # compress-once cache (q8 legs): end seq → compressed payload.
+        # A record that races onto both the fast path and a resync (or
+        # re-ships after a drop fault) must deliver the SAME dq bytes,
+        # or the leg's residual ledger would double-count the delta.
+        self._compressed: "collections.OrderedDict" = (
+            collections.OrderedDict()
+        )
         self.acked_seq = -1  # end_step durable at the follower
         self.records_shipped = 0
         self.ship_errors = 0
@@ -162,8 +186,16 @@ class WALShipper:
                 "replication_ship_errors_total",
                 component="replication", **labels,
             )
+            self._c_repl_saved = (
+                reg.counter(
+                    "compression_repl_bytes_saved_total",
+                    component="compression", **labels,
+                )
+                if self._compressor is not None else None
+            )
         else:
             self._c_shipped = self._c_errors = None
+            self._c_repl_saved = None
 
     # -- observability -------------------------------------------------------
     def lag(self) -> int:
@@ -275,6 +307,30 @@ class WALShipper:
                     return None  # idle tick: re-check stop/resync flags
             return self._queue.items.popleft()
 
+    def _compress_once(self, end: int, payload):
+        """Quantize one push record's deltas exactly once per end seq
+        (error feedback must never see the same record twice); re-ships
+        return the cached dq bytes so a follower-side duplicate skip
+        stays residual-neutral."""
+        with self._lock:
+            cached = self._compressed.get(end)
+        if cached is not None:
+            return cached
+        from ..compression.quantizers import compress_record_payload
+
+        out, f32_bytes, shipped_bytes = compress_record_payload(
+            payload, self._compressor
+        )
+        with self._lock:
+            self._compressed[end] = out
+            while len(self._compressed) > 1024:
+                self._compressed.popitem(last=False)
+            if f32_bytes:
+                self.repl_bytes_saved += f32_bytes - shipped_bytes
+        if f32_bytes and self._c_repl_saved is not None:
+            self._c_repl_saved.inc(f32_bytes - shipped_bytes)
+        return out
+
     def _resync(self) -> None:
         """Re-ship the primary's log tail past the acked cursor — the
         loss-free bootstrap/reconnect path.  Records that also sit on
@@ -305,6 +361,8 @@ class WALShipper:
                 return
             # "partition" and delays sleep inside the hook; the stream
             # resumes where it left off
+        if self._compressor is not None:
+            payload = self._compress_once(end, payload)
         conn = self._connect()
         if conn.proto == "bin":
             req = binf.encode_request(

@@ -91,7 +91,40 @@ VERB_NAMES: Dict[int, str] = {v: k for k, v in VERB_IDS.items()}
 ENC_F32 = 0
 ENC_BF16 = 1
 ENC_RAW = 2
-ENC_NAMES = {ENC_F32: "f32", ENC_BF16: "bf16", ENC_RAW: "raw"}
+# per-row-scaled int8 deltas (compression/quantizers.py): payload is
+# n × width raw int8, the f32 row scales ride a T_SCALE TLV.  A PUSH
+# codec only — pull/lease answers never quantize (absolute values
+# carry no residual to re-inject; docs/compression.md)
+ENC_Q8 = 3
+ENC_NAMES = {ENC_F32: "f32", ENC_BF16: "bf16", ENC_RAW: "raw",
+             ENC_Q8: "q8"}
+
+# the quantized encodings a binary-capable server ADVERTISES on its
+# hello answer ("ok proto=bin v=1 enc=bf16,q8" — hello_encs parses the
+# token back).  Old binary servers answer without the token; a client
+# must then assume bf16 only (the PR-13 vocabulary) and downgrade q8
+# frames to exact f32 — the negotiation matrix in docs/compression.md.
+WIRE_ENCS = ("bf16", "q8")
+LEGACY_BIN_ENCS = frozenset({"bf16"})
+
+
+def hello_ok_line(encs: Tuple[str, ...] = WIRE_ENCS) -> str:
+    """The binary-capable server's hello answer, advertising its
+    quantized-encoding vocabulary as a trailing token (old clients
+    check the ``ok proto=bin`` prefix only — parse-and-ignored)."""
+    return HELLO_OK + (" enc=" + ",".join(encs) if encs else "")
+
+
+def hello_encs(resp: str) -> frozenset:
+    """Quantized encodings negotiated from a server's hello answer:
+    the ``enc=`` token when present, else the legacy bf16-only set
+    (a PR-13 binary server predates the token)."""
+    for tok in resp.split()[1:]:
+        if tok.startswith("enc="):
+            return frozenset(
+                e for e in tok[4:].split(",") if e
+            )
+    return LEGACY_BIN_ENCS
 
 # response status codes — one byte; the mapping mirrors the line
 # protocol's ``err <reason>`` vocabulary exactly
@@ -129,6 +162,7 @@ T_HEAD = 9  # primary head seq on repl frames
 T_SEG = 10  # follower ack segment on repl answers
 T_APPLIED = 11  # applied count (repl answers)
 T_WALREC = 12  # wal_records (flush answers)
+T_SCALE = 13  # raw <f4 per-row scales of an ENC_Q8 payload
 
 _MAX_TLVS = 64
 _MAX_FRAME_DEFAULT = 64 << 20
@@ -414,6 +448,7 @@ __all__ = [
     "ENC_BF16",
     "ENC_F32",
     "ENC_NAMES",
+    "ENC_Q8",
     "ENC_RAW",
     "Frame",
     "FrameError",
@@ -441,6 +476,7 @@ __all__ = [
     "T_INV",
     "T_LAG",
     "T_PID",
+    "T_SCALE",
     "T_SEG",
     "T_SESS",
     "T_TRACE",
@@ -449,12 +485,15 @@ __all__ = [
     "VERB_IDS",
     "VERB_NAMES",
     "VERSION",
+    "WIRE_ENCS",
     "decode",
     "decode_split",
     "encode_request",
     "encode_response",
     "error_response",
     "frame_length",
+    "hello_encs",
+    "hello_ok_line",
     "peek_header",
     "peek_is_binary",
     "peek_verb_name",

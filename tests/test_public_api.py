@@ -118,7 +118,13 @@ MODULE_SYMBOLS = {
     "flink_parameter_server_tpu.utils.frames": [
         "Frame", "FrameError", "encode_request", "encode_response",
         "decode", "rows_to_payload", "rows_from_payload",
-        "HELLO_LINE", "VERB_IDS"],
+        "HELLO_LINE", "VERB_IDS", "ENC_Q8", "WIRE_ENCS",
+        "hello_ok_line", "hello_encs"],
+    "flink_parameter_server_tpu.compression": [
+        "DeltaCompressor", "PushAggregator", "ResidualStore",
+        "quantize_q8", "dequantize_q8", "q8_payload",
+        "q8_from_payload", "bf16_roundtrip", "record_deltas",
+        "compress_record_payload"],
     "flink_parameter_server_tpu.elastic": [
         "ElasticClusterConfig", "ElasticClusterDriver",
         "ElasticController", "ScalePolicy", "MembershipService",

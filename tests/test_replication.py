@@ -577,10 +577,20 @@ class TestFailover:
         try:
             result = driver.run(batches, timeout=120)
             assert result.rounds == len(batches)
-            counts = {
-                i.name: i.value for i in reg.instruments()
-                if i.labels.get("component") == "replication"
-            }
+
+            # the shipper leg is asynchronous: on a loaded box run()
+            # can return before the first record ships, so wait
+            # (bounded) for the counter instead of snapshotting it
+            def shipped() -> float:
+                return sum(
+                    i.value for i in reg.instruments()
+                    if i.name == "replication_records_shipped_total"
+                )
+
+            deadline = time.time() + 15
+            while shipped() < 1 and time.time() < deadline:
+                time.sleep(0.02)
+            assert shipped() >= 1
             # the partition window forced at least one shed read OR
             # zero replica reads in that window — either way the run
             # finished with correct routing; now kill + promote
@@ -588,7 +598,6 @@ class TestFailover:
             report = driver.promote_shard(0)
             assert report.failover_seconds < 5.0
             assert verify_against_log(driver.shards[0])
-            assert counts["replication_records_shipped_total"] >= 1
         finally:
             driver.stop()
 

@@ -56,6 +56,11 @@ def higher_is_better(unit: str) -> bool:
         return True
     if "slowdown" in u or "second" in u or re.search(r"\bms\b", u):
         return False
+    # bytes-on-wire metrics (bytes/round, bytes/request — the
+    # compression ledger, docs/compression.md) regress UPWARD; a rate
+    # like bytes/sec was already claimed by the "/sec" branch above
+    if "byte" in u:
+        return False
     return True
 
 

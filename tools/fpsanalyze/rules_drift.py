@@ -106,6 +106,7 @@ def default_drift_config(root: str) -> DriftConfig:
         catalog_doc_files=[
             "docs/observability.md", "docs/cluster.md",
             "docs/elastic.md", "docs/loadgen.md",
+            "docs/compression.md",
         ],
         known_components=KNOWN_COMPONENTS,
         metric_scan_prefixes=[pkg + "/"],

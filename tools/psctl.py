@@ -19,6 +19,8 @@ Usage::
                  [-n 16] [--json]
     psctl slo    --metrics HOST:PORT [--interval 2] [--iterations 0]
                  [--json]
+    psctl bytes  --metrics HOST:PORT [--interval 2] [--iterations 0]
+                 [--json]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -49,6 +51,21 @@ published gauges: healthy 1 → ``ok``; healthy 0 with both burns past
 1 → ``breach``, else ``burning`` (the engine's page_burn threshold is
 not exported, so this is the operator approximation of the
 ``SLOEngine`` verdict, not its byte-exact reproduction).
+
+``bytes`` is the wire-bytes operator view (docs/compression.md): two
+scrapes ``--interval`` apart yield per-verb ``fps_net_bytes_total``
+DELTAS (B/s each direction, ``role=server``), the compression plane's
+saved-bytes counters (``fps_compression_bytes_saved_total`` — client
+push codecs — and ``fps_compression_repl_bytes_saved_total`` — the
+replication legs), the derived push compression ratio
+(``(push bytes + saved) / push bytes``), and the per-connection
+ledger from the telemetry ``conns`` path with its ``proto``/``enc``
+columns — a mixed-enc fleet mid-rollout is one table: which
+connections negotiated ``q8``, and what the negotiated arm is saving.
+The per-connection ``ratio`` column applies the fleet-measured ratio
+of that connection's last payload encoding (exact per-conn byte
+splits are not tracked — the enc column says which arm the conn is
+on, the counters say what the arm saves).
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -502,6 +519,128 @@ def cmd_slo(args) -> int:
         time.sleep(args.interval)
 
 
+def cmd_bytes(args) -> int:
+    host, port = parse_addr(args.metrics)
+    prev: Optional[Dict[Tuple[str, tuple], float]] = None
+    prev_t = 0.0
+    shown = 0
+    while True:
+        try:
+            samples = parse_prometheus(scrape(host, port, "metrics"))
+            conns_doc = json.loads(scrape(host, port, "conns"))
+        except (OSError, ValueError) as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        now = time.time()
+        dt = now - prev_t if prev is not None else None
+
+        # per-verb byte totals + deltas (role=server: the shard edge)
+        verbs: Dict[str, Dict[str, float]] = {}
+        for (name, labels), v in samples.items():
+            if name != "fps_net_bytes_total":
+                continue
+            d = dict(labels)
+            if d.get("role") != "server":
+                continue
+            row = verbs.setdefault(
+                d.get("verb", "?"), {"in": 0.0, "out": 0.0}
+            )
+            row[d.get("direction", "in")] = (
+                row.get(d.get("direction", "in"), 0.0) + v
+            )
+        saved_push = _sum_named(
+            samples, "fps_compression_bytes_saved_total"
+        )
+        saved_repl = _sum_named(
+            samples, "fps_compression_repl_bytes_saved_total"
+        )
+        push_bytes = verbs.get("push", {}).get("in", 0.0)
+        ratio = (
+            (push_bytes + saved_push) / push_bytes
+            if push_bytes > 0 else None
+        )
+        conns = conns_doc.get("conns", [])
+
+        def enc_ratio(enc: str) -> str:
+            if enc in ("q8", "bf16") and ratio is not None:
+                return f"{ratio:.2f}x"
+            return "1.00x" if enc in ("f32", "raw") else "—"
+
+        if args.json:
+            print(json.dumps({
+                "verbs": verbs,
+                "compression_bytes_saved": saved_push,
+                "compression_repl_bytes_saved": saved_repl,
+                "push_ratio": ratio,
+                "conns": conns,
+            }, indent=2))
+            return 0
+
+        def rate(verb: str, direction: str) -> str:
+            if prev is None or not dt:
+                return "—"
+            d = (
+                _sum_named(samples, "fps_net_bytes_total",
+                           verb=verb, direction=direction,
+                           role="server")
+                - _sum_named(prev, "fps_net_bytes_total",
+                             verb=verb, direction=direction,
+                             role="server")
+            )
+            return f"{d / dt:,.0f}"
+
+        lines = [
+            f"psctl bytes — {host}:{port} — "
+            f"{time.strftime('%H:%M:%S', time.localtime(now))}",
+            "",
+        ]
+        rows = [
+            [verb, _fmt_bytes(row.get("in", 0)),
+             _fmt_bytes(row.get("out", 0)),
+             rate(verb, "in"), rate(verb, "out")]
+            for verb, row in sorted(verbs.items())
+        ]
+        if rows:
+            lines.append(_render_table(
+                ["verb", "bytes in", "bytes out", "in B/s", "out B/s"],
+                rows,
+            ))
+        else:
+            lines.append("(no fps_net_bytes_total samples — is wire "
+                         "accounting on?)")
+        lines.append("")
+        lines.append(
+            f"compression: push saved {_fmt_bytes(saved_push)}"
+            + (f"  (ratio {ratio:.2f}x)" if ratio is not None else "")
+            + f"    repl saved {_fmt_bytes(saved_repl)}"
+        )
+        if conns:
+            lines.append("")
+            lines.append(_render_table(
+                ["peer", "proto", "enc", "ratio", "bytes in",
+                 "bytes out", "last verb"],
+                [
+                    [c.get("peer", "?"), c.get("proto", "line"),
+                     c.get("enc", "") or "-",
+                     enc_ratio(c.get("enc", "")),
+                     _fmt_bytes(c.get("bytes_in", 0)),
+                     _fmt_bytes(c.get("bytes_out", 0)),
+                     c.get("last_verb", "")]
+                    for c in conns
+                ],
+            ))
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        prev, prev_t = samples, now
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
 def cmd_budget(args) -> int:
     host, port = parse_addr(args.metrics)
     try:
@@ -595,6 +734,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     slo.add_argument("--json", action="store_true",
                      help="emit the raw payload once")
     slo.set_defaults(fn=cmd_slo)
+
+    by = sub.add_parser(
+        "bytes",
+        help="per-verb wire-byte rates + compression-ratio table",
+    )
+    by.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    by.add_argument("--interval", type=float, default=2.0)
+    by.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = forever)")
+    by.add_argument("--raw", action="store_true",
+                    help="no screen clear (pipe/CI friendly)")
+    by.add_argument("--json", action="store_true",
+                    help="emit the raw payload once")
+    by.set_defaults(fn=cmd_bytes)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
