@@ -1172,6 +1172,62 @@ def _emit_compression_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_workloads_metric(platform: str, fallback: bool) -> None:
+    """Twelfth (opt-in) metric line: the workload-generic runtime.
+
+    FPS_BENCH_WORKLOADS=1 runs benchmarks/workload_battery.py — the
+    PA-classifier and count-min-sketch full-stack scenarios
+    (train-while-serve-while-resize-while-faulted, parity bitwise /
+    integer-exact) plus the short q8/aggregation soak arms — and
+    writes ``results/<platform>/workload_battery.{md,json}``, the
+    ROADMAP-5 acceptance artifact (docs/workloads.md).
+    FPS_BENCH_WORKLOADS_SECONDS sizes the soak arms (default 8).
+    Default 0 (the battery costs tens of seconds); failure degrades
+    to a value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_WORKLOADS", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_WORKLOADS={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "workload battery (PA + sketch full-stack scenarios)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        from benchmarks.workload_battery import run_workload_battery
+
+        r = run_workload_battery(
+            soak_seconds=float(os.environ.get(
+                "FPS_BENCH_WORKLOADS_SECONDS", "8"
+            ))
+        )
+        print(json.dumps({
+            "metric": metric,
+            "value": r["scenarios_passed"],
+            "unit": "scenarios passed",
+            "extra": {
+                "scenarios": [
+                    {k: s[k] for k in ("scenario", "workload", "ok",
+                                       "parity_mode")}
+                    for s in r["scenarios"]
+                ],
+                "soak_q8_goodput_rps":
+                    r["soak_arms"]["q8"]["goodput_rps"],
+                "soak_q8_bytes_saved":
+                    r["soak_arms"]["q8"]["compression_bytes_saved"],
+                "soak_q8_agg_combined_pushes":
+                    r["soak_arms"]["q8_agg"]["combined_pushes"],
+                "platform": r["platform"],
+            },
+        }))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "scenarios passed",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1204,6 +1260,7 @@ def main():
             _emit_hotcache_metric(platform, fallback)
             _emit_soak_metric(platform, fallback)
             _emit_compression_metric(platform, fallback)
+            _emit_workloads_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1263,6 +1320,7 @@ def main():
     _emit_hotcache_metric(platform, fallback)
     _emit_soak_metric(platform, fallback)
     _emit_compression_metric(platform, fallback)
+    _emit_workloads_metric(platform, fallback)
 
 
 if __name__ == "__main__":

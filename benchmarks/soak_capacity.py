@@ -13,12 +13,18 @@ Three phases, one committed artifact
      from a seeded Poisson schedule, latency anchored to the arrival
      timestamp (no coordinated omission), a nemesis schedule running
      underneath (partitions, a delay window, kill-primary→promote),
-     and the ONLY difference between arms the overload-control plane:
-     shard-edge shedding + retry budgets + per-shard breakers +
-     brownout ON vs all of it OFF.  Acceptance: the ON arm holds
-     goodput ≥ 80% of capacity with bounded admitted-request p99 and
-     ZERO invariant violations; the OFF arm collapses (goodput falls
-     to a fraction, p99 explodes into seconds);
+     and the ONLY difference between the headline arms the
+     overload-control plane: shard-edge shedding + retry budgets +
+     per-shard breakers + brownout ON vs all of it OFF.  Acceptance:
+     the ON arm holds goodput ≥ 80% of capacity with bounded
+     admitted-request p99 and ZERO invariant violations; the OFF arm
+     collapses (goodput falls to a fraction, p99 explodes into
+     seconds).  Two follow-on arms (the parked PR-14 item, live now
+     that proc shards made the curve bandwidth-sensitive) rerun the
+     ON configuration with ``wire_format="q8"`` (quantized push
+     deltas + error feedback) and additionally
+     ``push_aggregate=True`` (one combined uplink push per train
+     drain round);
   3. **autoscaler quality** — a diurnal-ramp trace with the
      :class:`~flink_parameter_server_tpu.elastic.controller
      .ElasticController` free to resize 2→4 shards; scored as
@@ -150,13 +156,24 @@ def run_soak_bench(
     offered = 2.0 * capacity
     arms: Dict[str, dict] = {}
     reports: Dict[str, object] = {}
-    for arm, control in (("off", False), ("on", True)):
+    # the PR-14 follow-on arms, live now that proc shards made the
+    # capacity curve bandwidth-sensitive: control ON plus the q8
+    # push-delta codec, and plus the two-level aggregation tree on the
+    # train-push path — same offered load, same nemesis schedule
+    for arm, control, wire_format, push_agg in (
+        ("off", False, "b64", False),
+        ("on", True, "b64", False),
+        ("on_q8", True, "q8", False),
+        ("on_q8_agg", True, "q8", True),
+    ):
         cfg = _base_config(
             duration_s=float(duration_s),
             offered_rps=offered,
             num_shards=headline[0],
             replication_factor=headline[1],
             overload_control=control,
+            wire_format=wire_format,
+            push_aggregate=push_agg,
             nemesis=_nemesis_schedule(duration_s),
             controller_policy=_fixed_controller_policy(headline[0]),
             # the OFF arm is allowed serve errors — collapse is the
@@ -241,6 +258,12 @@ def run_soak_bench(
         "goodput_frac_of_capacity_off": round(
             float(off["goodput_rps"]) / capacity, 3
         ),
+        "goodput_frac_of_capacity_on_q8": round(
+            float(arms["on_q8"]["goodput_rps"]) / capacity, 3
+        ),
+        "goodput_frac_of_capacity_on_q8_agg": round(
+            float(arms["on_q8_agg"]["goodput_rps"]) / capacity, 3
+        ),
         "autoscaler": auto,
         "invariants_ok": on_verdicts_ok,
         "timeline_on": [
@@ -276,6 +299,10 @@ def soak_artifact(r: dict) -> dict:
                 r["goodput_frac_of_capacity_on"],
             "goodput_frac_of_capacity_off":
                 r["goodput_frac_of_capacity_off"],
+            "goodput_frac_of_capacity_on_q8":
+                r["goodput_frac_of_capacity_on_q8"],
+            "goodput_frac_of_capacity_on_q8_agg":
+                r["goodput_frac_of_capacity_on_q8_agg"],
             "p99_ms_on": on["p99_ms"],
             "p99_ms_off": off["p99_ms"],
             "autoscaler_score": r["autoscaler"]["score"],
@@ -372,6 +399,35 @@ def _render_md(r: dict, stamp: str) -> str:
         f"{100 * r['goodput_frac_of_capacity_on']:.0f}% | "
         f"{on['p50_ms']} | {on['p99_ms']} | {on['shed']} | "
         f"{on['late']} | {on['error']} |",
+        f"| control ON + q8 push codec | "
+        f"{r['arms']['on_q8']['goodput_rps']} | "
+        f"{100 * r['goodput_frac_of_capacity_on_q8']:.0f}% | "
+        f"{r['arms']['on_q8']['p50_ms']} | "
+        f"{r['arms']['on_q8']['p99_ms']} | "
+        f"{r['arms']['on_q8']['shed']} | "
+        f"{r['arms']['on_q8']['late']} | "
+        f"{r['arms']['on_q8']['error']} |",
+        f"| control ON + q8 + aggregation tree | "
+        f"{r['arms']['on_q8_agg']['goodput_rps']} | "
+        f"{100 * r['goodput_frac_of_capacity_on_q8_agg']:.0f}% | "
+        f"{r['arms']['on_q8_agg']['p50_ms']} | "
+        f"{r['arms']['on_q8_agg']['p99_ms']} | "
+        f"{r['arms']['on_q8_agg']['shed']} | "
+        f"{r['arms']['on_q8_agg']['late']} | "
+        f"{r['arms']['on_q8_agg']['error']} |",
+        "",
+        f"q8 arm: push deltas ship as per-row-scaled int8 with error "
+        f"feedback (compression/) — "
+        f"{r['arms']['on_q8']['overload'].get('compression_bytes_saved', 0)}"
+        f" push bytes kept off the wire; the aggregation arm "
+        f"additionally combines the train workers' drain rounds into "
+        f"one uplink push each "
+        f"({r['arms']['on_q8_agg']['overload'].get('combined_pushes', 0)}"
+        f" combined pushes, "
+        f"{r['arms']['on_q8_agg']['overload'].get('combined_rows_saved', 0)}"
+        f" duplicate rows merged; exactly-once ledger balanced on the "
+        f"uplink).  The PR-14 follow-on arms (docs/compression.md), "
+        f"recorded per ROADMAP item 3.",
         "",
         f"ON-arm invariants (exactly-once ledger, lease staleness at "
         f"the widened bound {on['cache']['widened_bound']}, serving "

@@ -78,6 +78,19 @@ class ClusterConfig:
     window: int = 8
     chunk: int = 512
     wire_format: str = "b64"
+    # push semantics of the workload's deltas (docs/workloads.md):
+    # "delta" = fp32 gradient-style deltas (the default — quantized
+    # encodings apply when configured); "increment" = integer counter
+    # increments (streaming sketches), where a quantized write would
+    # break integer-exact counts, so q8/bf16 downgrade to exact fp32
+    # in _make_client — the same enforcement point as the BSP
+    # carve-out.  Integer increments are exact in fp32 up to 2^24.
+    push_semantics: str = "delta"
+    # the registered workload driving this topology (workloads/
+    # registry.py); set by the workload runtime so per-workload rates
+    # (workload_updates_total{workload=}) land on /metrics and the
+    # psctl `workloads` table
+    workload: Optional[str] = None
     # two-level aggregation tree (compression/aggregator.py): workers
     # rendezvous per round and a combiner issues ONE merged push per
     # shard (its own client, its own pid space — the exactly-once
@@ -364,6 +377,16 @@ class ClusterDriver:
         wire_format = cfg.wire_format
         if cfg.staleness_bound == 0 and wire_format in ("q8", "bf16"):
             wire_format = "b64"
+        # increment-semantics carve-out (docs/workloads.md): sketch
+        # pushes are integer bucket increments — quantizing them would
+        # deliver within-a-granule counts instead of exact ones, so
+        # the q8/bf16 paths are bypassed for every client of an
+        # increment workload (integer-exactness is pinned in
+        # tests/test_workloads.py)
+        if cfg.push_semantics == "increment" and wire_format in (
+            "q8", "bf16"
+        ):
+            wire_format = "b64"
         client = ClusterClient(
             [(srv.host, srv.port) for srv in self.servers],
             self.partitioner,
@@ -550,6 +573,16 @@ class ClusterDriver:
             if self.registry is not None
             else None
         )
+        # per-workload rate instrument (workloads/, docs/workloads.md):
+        # the `workloads` telemetry path and psctl table read this
+        c_updates = (
+            self.registry.counter(
+                "workload_updates_total", component="workloads",
+                workload=cfg.workload,
+            )
+            if self.registry is not None and cfg.workload is not None
+            else None
+        )
 
         def worker_loop(w: int) -> None:
             import jax.numpy as jnp
@@ -570,7 +603,21 @@ class ClusterDriver:
                     wb = dict(batch)
                     wb["mask"] = self._worker_mask(batch, w)
                     ids = np.asarray(self.logic.keys(wb))
-                    pulled = client.pull_batch(ids, mask=wb["mask"])
+                    # multi-key workloads (PA's sparse (B, K) feature
+                    # ids, a sketch's (B, depth) cells) pull several
+                    # params per record: broadcast the per-record row
+                    # mask over the trailing key lanes so coalescing
+                    # sees one mask lane per key
+                    kmask = np.asarray(wb["mask"])
+                    if ids.ndim > kmask.ndim:
+                        kmask = np.broadcast_to(
+                            kmask.reshape(
+                                kmask.shape
+                                + (1,) * (ids.ndim - kmask.ndim)
+                            ),
+                            ids.shape,
+                        )
+                    pulled = client.pull_batch(ids, mask=kmask)
                     if pull_barrier is not None:
                         pull_barrier.wait(timeout=timeout)
                     state, req, out = self._step_fn(
@@ -593,6 +640,8 @@ class ClusterDriver:
                     events[w] += int(wb["mask"].sum())
                     if c_rounds is not None:
                         c_rounds.inc()
+                    if c_updates is not None:
+                        c_updates.inc(int(wb["mask"].sum()))
                     if collect_outputs:
                         outputs[w].append(jax.tree.map(np.asarray, out))
                 states[w] = state

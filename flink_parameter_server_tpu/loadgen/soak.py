@@ -45,6 +45,15 @@ the stretched value), serving error budget, zero leaked threads.
 figure: SLO-seconds burned vs an ideal controller on the same trace
 (ideal = burns only where the offered load exceeds what the LARGEST
 configuration can serve at all).
+
+The runner is WORKLOAD-GENERIC (``SoakConfig.workload`` →
+workloads/registry.py): the table shape, train-push synthesis and
+read-id mapping come from the registered workload, and the push path
+can run the q8 codec (``wire_format="q8"``, bypassed for increment
+workloads) and the aggregation tree (``push_aggregate=True`` — one
+combined uplink push per train drain round, exactly-once on the
+uplink).  docs/workloads.md; the arms are recorded in
+``results/cpu/soak_capacity.md`` and the workload battery.
 """
 from __future__ import annotations
 
@@ -176,6 +185,22 @@ class SoakConfig:
     dim: int = 8
     num_shards: int = 2
     replication_factor: int = 1
+    # the registered workload under soak (workloads/registry.py):
+    # "mf" (the incumbent) | "pa" | "sketch" — table shape, push
+    # synthesis and read-id mapping all come from the workload, so the
+    # open-loop harness regresses any learner the registry knows
+    workload: str = "mf"
+    # train-push payload encoding (compression/, docs/compression.md):
+    # "q8" quantizes push deltas with error feedback — the PR-14
+    # follow-on arm, bandwidth-sensitive now that proc shards exist.
+    # Increment workloads (sketches) bypass it (exactness carve-out).
+    wire_format: str = "b64"
+    # two-level aggregation tree on the train-push path: the
+    # train workers rendezvous per drain round and ONE combined push
+    # per round crosses the wire through a combiner uplink client
+    # (compression/aggregator.py; the exactly-once ledger balances on
+    # the uplink)
+    push_aggregate: bool = False
     link_delay_ms: float = 1.0          # per-request mesh delay (c2s)
     # the goodput deadline: an answer later than this is badput
     slo_ms: float = 100.0
@@ -292,41 +317,43 @@ class SoakRunner:
     def __init__(self, config: SoakConfig, *, registry=None):
         self.config = config
         from ..telemetry.registry import MetricsRegistry
+        from ..workloads import WorkloadParams, create_workload
 
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
+        # num_users=64 keeps the MF logic identical to the pre-registry
+        # soak (worker state is never trained here — driver.run() is
+        # not called — but the table shape and init must not move under
+        # the capacity ledger); num_items/dim size the table
+        self.workload = create_workload(config.workload, WorkloadParams(
+            num_users=64, num_items=config.num_items, dim=config.dim,
+            seed=1,
+        ))
 
     # -- internals -----------------------------------------------------------
     def _build_driver(self, wal_dir: str):
-        from ..models.matrix_factorization import (
-            OnlineMatrixFactorization,
-            SGDUpdater,
-        )
         from ..replication.driver import ReplicatedClusterConfig
-        from ..utils.initializers import ranged_random_factor
+        from ..workloads import build_cluster_driver
 
         cfg = self.config
         cls = _make_driver_class()
-        driver = cls(
-            OnlineMatrixFactorization(
-                64, cfg.dim, updater=SGDUpdater(0.05), seed=1
-            ),
-            capacity=cfg.num_items,
-            value_shape=(cfg.dim,),
-            init_fn=ranged_random_factor(7, (cfg.dim,)),
+        driver = build_cluster_driver(
+            self.workload,
             config=ReplicatedClusterConfig(
                 num_shards=cfg.num_shards,
                 num_workers=1,
                 staleness_bound=None,  # serve-side async clock
                 wal_dir=wal_dir,
+                wire_format=cfg.wire_format,
                 replication_factor=cfg.replication_factor,
                 request_timeout=cfg.request_timeout,
                 connect_timeout=cfg.connect_timeout,
                 retry_timeout=cfg.retry_timeout,
             ),
+            driver_cls=cls,
             registry=self.registry,
-            nemesis_seed=cfg.seed,
+            driver_kwargs={"nemesis_seed": cfg.seed},
         )
         if cfg.overload_control:
             reg = self.registry
@@ -366,7 +393,7 @@ class SoakRunner:
                 registry=self.registry, worker=name,
             )
         client = ClusterClient(
-            value_shape=(cfg.dim,),
+            value_shape=self.workload.value_shape,
             membership=driver.membership,
             registry=self.registry,
             worker=name,
@@ -390,14 +417,22 @@ class SoakRunner:
         from ..cluster.client import ClusterClient
 
         cfg = self.config
+        # the push-path codec rides the TRAIN clients only (pulls are
+        # never quantized); increment workloads get the exactness
+        # carve-out here, same rule as ClusterDriver._make_client
+        wire_format = cfg.wire_format
+        if self.workload.push_semantics == "increment" and \
+                wire_format in ("q8", "bf16"):
+            wire_format = "b64"
         return ClusterClient(
-            value_shape=(cfg.dim,),
+            value_shape=self.workload.value_shape,
             membership=driver.membership,
             registry=self.registry,
             worker=name,
             timeout=cfg.request_timeout,
             connect_timeout=cfg.connect_timeout,
             retry_timeout=cfg.retry_timeout,
+            wire_format=wire_format,
             priority=PRIORITY_CRITICAL if cfg.overload_control else None,
         )
 
@@ -439,6 +474,7 @@ class SoakRunner:
             )
             if cfg.overload_control else None
         )
+        workload = self.workload
         t_wall0 = time.perf_counter()
         wal_root = tempfile.mkdtemp(prefix="soak-wal-")
         driver = self._build_driver(wal_root)
@@ -460,6 +496,8 @@ class SoakRunner:
         deadline_sheds = [0]
         error_samples: List[str] = []
         err_lock = threading.Lock()
+        push_agg = None
+        agg_stop = threading.Event()
         try:
             if cfg.link_delay_ms > 0:
                 for proxy in driver.mesh.values():
@@ -472,10 +510,42 @@ class SoakRunner:
                 )
                 serve_clients.append(sc)
                 caches.append(cache)
-            for w in range(cfg.train_workers):
-                train_clients.append(
-                    self._make_train_client(driver, f"loadgen-train-{w}")
+            # the aggregation-tree arm funnels every train push through
+            # ONE combiner uplink client (its own pid space — the
+            # exactly-once ledger balances on the uplink); otherwise
+            # one client per train worker
+            if cfg.push_aggregate and cfg.train_workers > 1:
+                from ..compression.aggregator import PushAggregator
+
+                class _StopAwareAggregator(PushAggregator):
+                    """Rendezvous combiner whose shutdown is decided AT
+                    a barrier round: the action flips ``finished`` when
+                    the stop event is set, so every worker observes the
+                    flip after the SAME rendezvous and exits in
+                    lockstep (no sibling left parked at the barrier)."""
+
+                    finished = False
+
+                    def _combine(self) -> None:
+                        super()._combine()
+                        if agg_stop.is_set():
+                            self.finished = True
+
+                uplink = self._make_train_client(
+                    driver, "loadgen-train-uplink"
                 )
+                push_agg = _StopAwareAggregator(
+                    cfg.train_workers, uplink,
+                    registry=self.registry, timeout=30.0,
+                )
+                train_clients.append(uplink)
+            else:
+                for w in range(cfg.train_workers):
+                    train_clients.append(
+                        self._make_train_client(
+                            driver, f"loadgen-train-{w}"
+                        )
+                    )
 
             # warmup (closed loop, unrecorded): every client touches
             # every shard before the open-loop clock starts
@@ -485,19 +555,19 @@ class SoakRunner:
                 for _ in range(per_gen):
                     try:
                         serve_clients[g].pull_batch(
-                            population.sample(wrng).ids
+                            workload.soak_read_ids(
+                                population.sample(wrng).ids
+                            )
                         )
                     except Exception:  # noqa: BLE001 — warmup only
                         pass
             for tc in train_clients:
                 for _ in range(4):
                     try:
-                        tc.push_batch(
-                            population.sample(wrng).ids,
-                            np.zeros(
-                                (cfg.batch_ids, cfg.dim), np.float32
-                            ),
+                        wids, wdeltas = workload.soak_push(
+                            wrng, population.sample(wrng).ids
                         )
+                        tc.push_batch(wids, wdeltas * 0.0)
                     except Exception:  # noqa: BLE001 — warmup only
                         pass
 
@@ -523,6 +593,15 @@ class SoakRunner:
 
             train_q: "_queue.Queue" = _queue.Queue()
 
+            def _record_pushed(batch, done: float) -> None:
+                for offset, target, _req in batch:
+                    lat = done - target
+                    ledger.record(
+                        float(offset),
+                        "ok" if lat <= cfg.slo_ms / 1e3 else "late",
+                        lat,
+                    )
+
             def train_worker_loop(w: int) -> None:
                 rng = np.random.default_rng(cfg.seed + 700 + w)
                 client = train_clients[w]
@@ -547,24 +626,62 @@ class SoakRunner:
                             train_q.put(None)  # re-arm shutdown
                             break
                         batch.append(nxt)
-                    ids = np.concatenate([b[2].ids for b in batch])
-                    deltas = rng.standard_normal(
-                        (len(ids), cfg.dim)
-                    ).astype(np.float32) * 1e-3
+                    ids, deltas = workload.soak_push(
+                        rng, np.concatenate([b[2].ids for b in batch])
+                    )
                     try:
                         client.push_batch(ids, deltas)
-                        done = time.perf_counter()
-                        for offset, target, _req in batch:
-                            lat = done - target
-                            ledger.record(
-                                float(offset),
-                                "ok" if lat <= cfg.slo_ms / 1e3
-                                else "late",
-                                lat,
-                            )
+                        _record_pushed(batch, time.perf_counter())
                     except Exception as e:  # noqa: BLE001
                         for offset, _target, req in batch:
                             _record_error(req, offset, e)
+
+            def train_worker_agg_loop(w: int) -> None:
+                """The aggregation-tree train path: every drain round is
+                a rendezvous (possibly with an EMPTY contribution — the
+                barrier must see all workers each round), and the
+                combiner pushes one merged batch through the uplink.
+                Exit is lockstep via the barrier-action stop flag;
+                stragglers left in the queue are drained by the main
+                thread directly through the uplink."""
+                rng = np.random.default_rng(cfg.seed + 700 + w)
+                while True:
+                    batch = []
+                    try:
+                        item = train_q.get(timeout=0.05)
+                        if item is not None:
+                            batch.append(item)
+                    except _queue.Empty:
+                        pass
+                    while batch and len(batch) < 32:
+                        try:
+                            nxt = train_q.get_nowait()
+                        except _queue.Empty:
+                            break
+                        if nxt is not None:
+                            batch.append(nxt)
+                    if batch:
+                        ids, deltas = workload.soak_push(
+                            rng,
+                            np.concatenate([b[2].ids for b in batch]),
+                        )
+                    else:
+                        ids = np.empty(0, np.int64)
+                        deltas = np.empty(
+                            (0,) + tuple(workload.value_shape),
+                            np.float32,
+                        )
+                    try:
+                        push_agg.push_batch(w, ids, deltas)
+                        if batch:
+                            _record_pushed(batch, time.perf_counter())
+                    except BaseException as e:  # noqa: BLE001
+                        for offset, _target, req in batch:
+                            _record_error(req, offset, e)
+                        if agg_stop.is_set():
+                            return  # barrier broken at teardown
+                    if push_agg.finished:
+                        return
 
             def generator_loop(g: int) -> None:
                 rng = np.random.default_rng(cfg.seed + 100 + g)
@@ -606,7 +723,7 @@ class SoakRunner:
                             brownout.note_shed()
                         continue
                     try:
-                        serve.pull_batch(req.ids)
+                        serve.pull_batch(workload.soak_read_ids(req.ids))
                         with err_lock:
                             served[0] += 1
                         lat = time.perf_counter() - target
@@ -648,7 +765,11 @@ class SoakRunner:
             ]
             train_threads = [
                 threading.Thread(
-                    target=train_worker_loop, args=(w,),
+                    target=(
+                        train_worker_agg_loop if push_agg is not None
+                        else train_worker_loop
+                    ),
+                    args=(w,),
                     name=f"loadgen-train-worker-{w}", daemon=True,
                 )
                 for w in range(cfg.train_workers)
@@ -665,11 +786,39 @@ class SoakRunner:
                 t.start()
             for t in threads:
                 t.join()
-            # drain the push queue, then release the workers
-            for _ in train_threads:
-                train_q.put(None)
-            for t in train_threads:
-                t.join(timeout=60)
+            if push_agg is not None:
+                # lockstep shutdown at a rendezvous round, then drain
+                # stragglers directly through the uplink
+                agg_stop.set()
+                for t in train_threads:
+                    t.join(timeout=60)
+                push_agg.abort()
+                drain_rng = np.random.default_rng(cfg.seed + 799)
+                uplink_client = train_clients[0]
+                while True:
+                    try:
+                        item = train_q.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if item is None:
+                        continue
+                    offset, target, req = item
+                    try:
+                        ids, deltas = workload.soak_push(
+                            drain_rng, req.ids
+                        )
+                        uplink_client.push_batch(ids, deltas)
+                        _record_pushed(
+                            [item], time.perf_counter()
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        _record_error(req, offset, e)
+            else:
+                # drain the push queue, then release the workers
+                for _ in train_threads:
+                    train_q.put(None)
+                for t in train_threads:
+                    t.join(timeout=60)
             stop.set()
             nem.join(timeout=10)
         finally:
@@ -725,7 +874,23 @@ class SoakRunner:
             "widen_factor": (
                 cfg.brownout_widen if cfg.overload_control else 1.0
             ),
+            "wire_format": cfg.wire_format,
+            "push_aggregate": push_agg is not None,
         }
+        if push_agg is not None:
+            overload_stats["combined_pushes"] = push_agg.rounds_combined
+            overload_stats["combined_rows_saved"] = max(
+                0, push_agg.rows_in - push_agg.rows_pushed
+            )
+        # push-path codec effect (compression/): bytes the q8 arm kept
+        # off the wire, summed over every train client's compressor
+        saved = sum(
+            int(inst.value)
+            for inst in self.registry.instruments()
+            if inst.name == "compression_bytes_saved_total"
+        )
+        if saved:
+            overload_stats["compression_bytes_saved"] = saved
         if cfg.overload_control:
             overload_stats["client_deadline_sheds"] = deadline_sheds[0]
             overload_stats["shard_edge_sheds"] = int(sum(
@@ -813,18 +978,19 @@ def closed_loop_capacity(
                     driver, f"loadgen-calib-train-{g}"
                 )
             )
+        workload = runner.workload
         wrng = np.random.default_rng(cfg.seed + 999)
         for g in range(cfg.generators):
             for _ in range(12):
                 req = population.sample(wrng)
-                serve_clients[g].pull_batch(req.ids)
+                serve_clients[g].pull_batch(
+                    workload.soak_read_ids(req.ids)
+                )
                 # pushes too: the first push of each padded bucket
                 # shape pays a jax scatter compile (~100 ms) that
                 # belongs to warmup, not the measured tail
-                train_clients[g].push_batch(
-                    req.ids,
-                    np.zeros((len(req.ids), cfg.dim), np.float32),
-                )
+                wids, wdeltas = workload.soak_push(wrng, req.ids)
+                train_clients[g].push_batch(wids, wdeltas * 0.0)
 
         def loop(g: int) -> None:
             rng = np.random.default_rng(cfg.seed + 500 + g)
@@ -833,13 +999,12 @@ def closed_loop_capacity(
                     req = population.sample(rng)
                     t0 = time.perf_counter()
                     if req.kind == "serve":
-                        serve_clients[g].pull_batch(req.ids)
+                        serve_clients[g].pull_batch(
+                            workload.soak_read_ids(req.ids)
+                        )
                     else:
                         train_clients[g].push_batch(
-                            req.ids,
-                            rng.standard_normal(
-                                (len(req.ids), cfg.dim)
-                            ).astype(np.float32) * 1e-3,
+                            *workload.soak_push(rng, req.ids)
                         )
                     lat[g].append(time.perf_counter() - t0)
             except BaseException as e:  # noqa: BLE001 — re-raised

@@ -112,6 +112,57 @@ def check_parity(
     )
 
 
+def check_parity_bitwise(
+    values: np.ndarray, oracle: np.ndarray
+) -> Verdict:
+    """Final table vs the oracle, BIT FOR BIT — the parity mode for
+    workloads whose update combine is structurally deterministic
+    (workloads/pa.py: the on-device dense combine leaves exactly one
+    fp32 row per id per round on both arms).  Same verdict name as the
+    allclose mode so corpus expectations stay uniform; the detail says
+    which bar was applied."""
+    if values.shape != oracle.shape:
+        return Verdict(
+            "final_table_parity", False,
+            f"shape {values.shape} vs oracle {oracle.shape}",
+        )
+    a = np.asarray(values, np.float32)
+    b = np.asarray(oracle, np.float32)
+    mismatched = int((a.view(np.uint32) != b.view(np.uint32)).sum())
+    return Verdict(
+        "final_table_parity", mismatched == 0,
+        f"bitwise: mismatched_words={mismatched} of {a.size}"
+        + ("" if mismatched == 0 else
+           f" max_abs_err={float(np.abs(a - b).max()):.3e}"),
+    )
+
+
+def check_count_parity(
+    values: np.ndarray, oracle: np.ndarray
+) -> Verdict:
+    """Integer-exact parity for increment workloads (sketches): every
+    delivered counter must be an integer and EQUAL the ground-truth
+    count — no float tolerance.  Exactness is legitimate because
+    integer increments are exact in fp32 below 2^24 and integer adds
+    commute, so no schedule (retries, promotion replay, resharding,
+    multi-worker interleaving) may change a single count."""
+    if values.shape != oracle.shape:
+        return Verdict(
+            "final_table_parity", False,
+            f"shape {values.shape} vs oracle {oracle.shape}",
+        )
+    v = np.asarray(values, np.float64)
+    nonint = int((v != np.round(v)).sum())
+    diff = int((v != np.asarray(oracle, np.float64)).sum())
+    total = int(v.sum())
+    ok = nonint == 0 and diff == 0
+    return Verdict(
+        "final_table_parity", ok,
+        f"integer-exact: total_count={total} "
+        f"mismatched_cells={diff} non_integer_cells={nonint}",
+    )
+
+
 def check_staleness(
     samples: Sequence[int], bound: Optional[int]
 ) -> Verdict:
@@ -243,11 +294,13 @@ __all__ = [
     "StalenessSampler",
     "ThreadLedger",
     "Verdict",
+    "check_count_parity",
     "check_exactly_once",
     "check_lease_staleness",
     "check_lock_inversions",
     "check_no_errors",
     "check_parity",
+    "check_parity_bitwise",
     "check_serving_budget",
     "check_staleness",
 ]

@@ -10,10 +10,14 @@ Execution model of one scenario (:func:`run_scenario`):
      front door is a :class:`~.proxy.ChaosProxy`, spliced in by
      :class:`~.proxy.ProxiedServer` so worker clients, the migration
      data plane and replication heartbeats all cross the mesh;
-  3. train the standard seeded MF workload while a dedicated nemesis
-     thread waits on the ROUND counter and fires the schedule's ops in
-     order, a reader thread issues serving pulls through its own
-     membership client, and a sampler polls the staleness spread;
+  3. train the scenario's REGISTERED workload (``Scenario.workload`` →
+     workloads/registry.py: MF, the PA classifier, or the count-min
+     sketch layer — the same seeded stream its oracle saw) while a
+     dedicated nemesis thread waits on the ROUND counter and fires the
+     schedule's ops in order, a reader thread issues serving pulls
+     PLUS the workload's own serving probes (predict / query / topk)
+     through its own membership client, and a sampler polls the
+     staleness spread;
   4. tear everything down and run the invariant checkers
      (:mod:`.invariants`); on failure, dump the flight recorder and
      the canonical schedule JSON — the ``(seed, schedule)`` pair any
@@ -40,7 +44,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..cluster.driver import ClusterConfig, ClusterDriver
 from ..elastic.controller import ElasticClusterConfig, ElasticClusterDriver
 from ..replication.driver import (
     ReplicatedClusterConfig,
@@ -56,7 +59,6 @@ from .invariants import (
     check_lease_staleness,
     check_lock_inversions,
     check_no_errors,
-    check_parity,
     check_serving_budget,
     check_staleness,
 )
@@ -135,61 +137,42 @@ class NemesisReplicatedDriver(_NemesisMeshMixin, ReplicatedClusterDriver):
 
 
 # ---------------------------------------------------------------------------
-# workload / oracle
+# workload / oracle (workloads/registry.py: any registered learner)
 # ---------------------------------------------------------------------------
 
 _ORACLE_CACHE: Dict[Tuple, np.ndarray] = {}
 
 
-def _workload(s: Scenario):
-    from ..data.movielens import synthetic_ratings
-    from ..data.streams import microbatches
-    from ..utils.initializers import ranged_random_factor
+def _make_workload(s: Scenario):
+    """Resolve the scenario's workload through the registry — the
+    stream/data seed is FIXED (WorkloadParams.seed default) so every
+    scenario on the same shape shares one stream and one oracle;
+    ``s.seed`` seeds the FAULTS, not the data."""
+    from ..workloads import WorkloadParams, create_workload
 
-    cols = synthetic_ratings(
-        s.num_users, s.num_items, s.rounds * s.batch, seed=3
-    )
-    batches = list(microbatches(cols, s.batch))
-    init = ranged_random_factor(7, (s.dim,))
-    return batches, init
-
-
-def _logic(s: Scenario):
-    from ..models.matrix_factorization import (
-        OnlineMatrixFactorization,
-        SGDUpdater,
-    )
-
-    return OnlineMatrixFactorization(
-        s.num_users, s.dim, updater=SGDUpdater(0.05), seed=1
-    )
+    return create_workload(s.workload, WorkloadParams(
+        rounds=s.rounds, batch=s.batch, num_users=s.num_users,
+        num_items=s.num_items, dim=s.dim, num_workers=s.num_workers,
+    ))
 
 
 def oracle_values(s: Scenario) -> np.ndarray:
-    """The fault-free final table for the scenario's stream — a static
-    2-shard BSP run (the table is shard-count independent; the elastic
-    parity suite pins that).  Cached per workload shape."""
-    key = (s.rounds, s.batch, s.num_users, s.num_items, s.dim,
-           s.num_workers)
+    """The fault-free final table for the scenario's stream, under the
+    workload's own oracle (workloads/: a static 2-shard BSP cluster
+    run for MF, the StreamingDriver for PA's bitwise bar, a pure-numpy
+    bincount for the sketch's integer counts).  Cached per workload
+    shape."""
+    key = (s.workload, s.rounds, s.batch, s.num_users, s.num_items,
+           s.dim, s.num_workers)
     cached = _ORACLE_CACHE.get(key)
     if cached is not None:
         return cached
-    batches, init = _workload(s)
-    driver = ClusterDriver(
-        _logic(s), capacity=s.num_items, value_shape=(s.dim,),
-        init_fn=init,
-        config=ClusterConfig(
-            num_shards=2, num_workers=s.num_workers, partition="hash",
-        ),
-        registry=False,
-    )
-    with driver:
-        values = driver.run(batches).values
+    values = np.asarray(_make_workload(s).oracle_values())
     _ORACLE_CACHE[key] = values
     return values
 
 
-def _build_driver(s: Scenario, init, wal_dir: str, registry):
+def _build_driver(s: Scenario, workload, wal_dir: str, registry):
     common = dict(
         num_shards=s.num_shards,
         num_workers=s.num_workers,
@@ -206,10 +189,11 @@ def _build_driver(s: Scenario, init, wal_dir: str, registry):
     else:
         cfg = ElasticClusterConfig(**common)
         cls = NemesisElasticDriver
-    return cls(
-        _logic(s), capacity=s.num_items, value_shape=(s.dim,),
-        init_fn=init, config=cfg, registry=registry,
-        nemesis_seed=s.seed,
+    from ..workloads import build_cluster_driver
+
+    return build_cluster_driver(
+        workload, config=cfg, driver_cls=cls, registry=registry,
+        driver_kwargs={"nemesis_seed": s.seed},
     )
 
 
@@ -353,8 +337,9 @@ def run_scenario(
     the flight-recorder blackbox and the canonical schedule JSON."""
     reg = registry if registry is not None else MetricsRegistry()
     t0 = time.perf_counter()
+    workload = _make_workload(scenario)
     oracle = oracle_values(scenario) if scenario.parity else None
-    batches, init = _workload(scenario)
+    batches = workload.batches()
     wal_dir = tempfile.mkdtemp(prefix=f"{scenario.name}-", dir=wal_root)
     ledger = ThreadLedger()
 
@@ -392,7 +377,7 @@ def run_scenario(
 
     try:
         with capture_cm as w:
-            driver = _build_driver(scenario, init, wal_dir, reg)
+            driver = _build_driver(scenario, workload, wal_dir, reg)
             driver.start()
 
             def round_hook(worker: int, rnd: int) -> None:
@@ -438,8 +423,14 @@ def run_scenario(
             def reader_loop() -> None:
                 client = driver._make_client(worker="nemesis-reader")
                 ids = np.arange(
-                    min(8, scenario.num_items), dtype=np.int64
+                    min(8, workload.capacity), dtype=np.int64
                 )
+                # workload serving probes (predict / query / topk —
+                # workloads/serving.py handlers, minus the socket):
+                # the error budget covers the workload's own verbs
+                # through the fault window, not just raw pulls
+                probe_rng = np.random.default_rng(scenario.seed + 17)
+                has_probes = bool(workload.serving_verbs)
                 cache = None
                 if scenario.hotcache:
                     # the cached serving reader (hotcache/): every read
@@ -461,6 +452,14 @@ def run_scenario(
                             served[0] += 1
                         except Exception:  # noqa: BLE001 — budgeted
                             read_errors[0] += 1
+                        if has_probes:
+                            probe = workload.probe_request(probe_rng)
+                            if probe is not None:
+                                try:
+                                    workload.serve(client, *probe)
+                                    served[0] += 1
+                                except Exception:  # noqa: BLE001
+                                    read_errors[0] += 1
                         stop_reader.wait(0.004)
                 finally:
                     if cache is not None:
@@ -522,7 +521,10 @@ def run_scenario(
                 "final_table_parity", False, "run produced no table"
             ))
         else:
-            verdicts.append(check_parity(values, oracle))
+            # the workload declares its own parity bar (workloads/):
+            # allclose fp32 for MF, bitwise for PA, integer-exact for
+            # sketches
+            verdicts.append(workload.parity_verdict(values, oracle))
     if scenario.serving_reads:
         verdicts.append(check_serving_budget(
             served[0], read_errors[0], budget=serving_budget

@@ -32,12 +32,15 @@ round-trips byte-identically through :meth:`Scenario.to_json` /
 committed as.
 
 ``BUILTIN_SCENARIOS`` is the fixed-seed battery tier-1 replays —
-eleven scenarios covering every proxy fault class, including the
+thirteen scenarios covering every proxy fault class, including the
 asymmetric partition splitting a live migration,
-kill-primary-under-partition, and the partition-client-mid-lease
-schedule proving the hot-key cache's staleness bound holds through a
-fault (hotcache/, docs/hotcache.md) — plus ``VIOLATION_SCENARIO``,
-the deliberately seeded corruption the checkers must catch.
+kill-primary-under-partition, the partition-client-mid-lease schedule
+proving the hot-key cache's staleness bound holds through a fault
+(hotcache/, docs/hotcache.md), and the two ROADMAP-5 full-stack
+workload scenarios (``pa_full_stack``, ``sketch_full_stack``:
+train-while-serve-while-resize-while-faulted for the non-MF learners,
+workloads/ + docs/workloads.md) — plus ``VIOLATION_SCENARIO``, the
+deliberately seeded corruption the checkers must catch.
 """
 from __future__ import annotations
 
@@ -113,6 +116,12 @@ class Scenario:
     name: str
     ops: Tuple[NemesisOp, ...]
     seed: int = 0
+    # the registered workload this scenario trains (workloads/
+    # registry.py): "mf" | "pa" | "sketch" — the runner resolves the
+    # logic, stream, init and PARITY MODE (allclose for MF, bitwise
+    # for PA, integer-exact for sketches) through the registry, so
+    # one schedule vocabulary drives every learner
+    workload: str = "mf"
     rounds: int = 12
     batch: int = 96
     num_users: int = 48
@@ -371,6 +380,53 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
         ),
         seed=110,
         request_timeout=1.0,
+    ),
+    # 12. ROADMAP-5 acceptance, PA: the passive-aggressive classifier
+    # through the FULL stack — train-while-serve-while-resize-while-
+    # faulted: a both-ways partition, a live scale-out, then
+    # kill-primary→promote, with the serving reader issuing `predict`
+    # probes throughout.  num_workers=1 because the parity bar is
+    # BITWISE (workloads/pa.py: with one writer the dense-combined
+    # update order is structurally deterministic; two writers'
+    # interleaved fp32 adds are not associative).
+    Scenario(
+        "pa_full_stack",
+        (
+            NemesisOp(3, "partition", shard=0, mode="both", ms=250.0),
+            NemesisOp(5, "scale_out"),
+            NemesisOp(8, "kill_shard", shard=1),
+            NemesisOp(8, "promote_shard", shard=1),
+        ),
+        seed=112,
+        rounds=14,
+        num_workers=1,
+        replicated=True,
+        workload="pa",
+    ),
+    # 13. ROADMAP-5 acceptance, sketches: the count-min layer through
+    # the same resize+failover gauntlet PLUS a mid-frame RST on a push
+    # request — the torn-frame replay must not lose or double a single
+    # increment.  wire_format="q8" is REQUESTED to pin the
+    # increment-semantics carve-out: the driver bypasses quantization
+    # for increment workloads, so counts stay integer-exact (the
+    # parity checker runs with no float tolerance) even though the
+    # config asked for the quantized codec.  Two workers: integer adds
+    # commute, so exactness must survive interleaving too.
+    Scenario(
+        "sketch_full_stack",
+        (
+            NemesisOp(3, "truncate_next", shard=0, mode="c2s",
+                      keep_frac=0.5, cut="payload"),
+            NemesisOp(4, "partition", shard=1, mode="both", ms=250.0),
+            NemesisOp(6, "scale_out"),
+            NemesisOp(9, "kill_shard", shard=0),
+            NemesisOp(9, "promote_shard", shard=0),
+        ),
+        seed=113,
+        rounds=14,
+        replicated=True,
+        workload="sketch",
+        wire_format="q8",
     ),
 )
 

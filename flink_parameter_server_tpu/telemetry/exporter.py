@@ -234,10 +234,24 @@ class TelemetryServer(LineServer):
             body = json.dumps({"conns": self.conn_table()}) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("workloads"):
+            # the live per-workload rate table (workloads/runtime.py):
+            # cumulative update/prediction/query counters + query
+            # latency percentiles per registered workload — `psctl
+            # workloads` diffs two scrapes into rates
+            from ..workloads.runtime import workload_table
+
+            body = json.dumps(
+                {"workloads": workload_table(self.registry),
+                 "run_id": self.registry.run_id}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         else:
             body = (
                 f"unknown path {path!r} "
-                f"(metrics|healthz|hotkeys|hot|budget|conns)\n"
+                f"(metrics|healthz|hotkeys|hot|budget|conns|"
+                f"workloads)\n"
             )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"

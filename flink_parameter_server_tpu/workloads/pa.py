@@ -1,0 +1,223 @@
+"""The passive-aggressive classifier workload (PAPER.md §0; SURVEY §2 #9).
+
+The model is the scalar weight vector keyed by feature id
+(``models/passive_aggressive.py``), run through
+:class:`~.base.DenseCombineLogic` so every round's duplicate-feature
+lane sums combine ON DEVICE — which is what makes the parity mode
+**bitwise**: a BSP cluster run (through sockets, WAL, migration,
+promotion, retries) must reproduce the single-process streaming
+oracle bit for bit.  The oracle runs the same standalone-jitted step
+the cluster workers execute (:meth:`~.PAClassifierWorkload
+.oracle_values` — the literal StreamingDriver's whole-program jit may
+reassociate float sums by ulps under XLA fusion; the two are pinned
+allclose).  The stream is a seeded sparse linear-classification task
+(features ~70% zero, labels from a hidden weight vector), with a
+``rec`` record-index column for worker routing.
+
+Serving verb ``predict``: sparse examples in, margins out — one
+coalesced pull of the present feature ids per request.
+
+Compression note (docs/workloads.md): PA pushes are fp32 deltas —
+``push_semantics="delta"`` — so the ``q8`` error-feedback path applies
+under SSP/async exactly as for MF; BSP workers still get the bound-0
+exact carve-out.  The PA-compatibility of the error-feedback rule is
+property-tested in tests/test_workloads.py.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .base import DenseCombineLogic, Workload, WorkloadParams
+
+
+def _pa_stream(params: WorkloadParams):
+    """Seeded sparse classification stream: (X, y), deterministic."""
+    p = params
+    rng = np.random.default_rng(p.seed)
+    F = int(p.num_items)
+    n = int(p.rounds) * int(p.batch)
+    w_true = rng.normal(0, 1, F)
+    X = rng.normal(0, 1, (n, F)).astype(np.float32)
+    X[rng.random(X.shape) < 0.7] = 0.0
+    # keep every example non-empty (an all-zero row pulls nothing and
+    # the hinge loss is degenerate): give it one feature back
+    empty = ~(X != 0).any(axis=1)
+    if empty.any():
+        X[empty, rng.integers(0, F, int(empty.sum()))] = 1.0
+    y = np.sign(X @ w_true + 1e-9).astype(np.float32)
+    return X, y
+
+
+class PAClassifierWorkload(Workload):
+    name = "pa"
+    push_semantics = "delta"
+    parity = "bitwise"
+    serving_verbs: Tuple[str, ...] = ("predict",)
+    worker_key = "rec"
+
+    def __init__(self, params: WorkloadParams = None, *, C: float = 1.0):
+        super().__init__(params)
+        self.C = float(C)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.params.num_items)  # the feature space
+
+    @property
+    def value_shape(self) -> Tuple[int, ...]:
+        return ()
+
+    def _rule(self):
+        from ..models.passive_aggressive import PARule
+
+        return PARule("PA-I", C=self.C)
+
+    def make_logic(self):
+        from ..models.passive_aggressive import PassiveAggressiveBinary
+
+        return DenseCombineLogic(
+            PassiveAggressiveBinary(self._rule()), self.capacity
+        )
+
+    def proc_init(self) -> Optional[dict]:
+        return {"kind": "zeros"}
+
+    def batches(self):
+        from ..data.streams import sparse_feature_batches
+
+        p = self.params
+        X, y = _pa_stream(p)
+        out = []
+        rec = 0
+        for b in sparse_feature_batches(X, y, p.batch, epochs=1):
+            b = dict(b)
+            # stable per-record routing column (entity affinity is
+            # per-example for online classification)
+            n = len(b["label"])
+            b["rec"] = np.arange(rec, rec + n, dtype=np.int64)
+            rec += n
+            out.append(b)
+        return out
+
+    def oracle_values(self) -> np.ndarray:
+        """The streaming oracle — a sequential single-process run of
+        the SAME standalone-jitted step the cluster workers execute
+        (gather → step → combine → one f32 add per touched id).
+
+        Why not :meth:`streaming_driver_values` directly: the
+        StreamingDriver's transform loop jits gather+step+scatter as
+        ONE XLA program, and XLA's fusion may reassociate the step's
+        float sums differently there than in the standalone-jitted
+        step program the cluster runs — a compiler artifact worth ulps
+        at some shapes, not an execution-semantics difference (the two
+        are pinned allclose in tests/test_workloads.py).  The BITWISE
+        bar exists to catch distributed-runtime bugs — routing, WAL
+        replay, migration, promotion, retry dedupe — so the oracle
+        holds the numerics fixed by running the identical compiled
+        step artifact."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.dedup import aggregate_deltas
+
+        logic = self.make_logic()
+        step = jax.jit(logic.step)
+        table = np.zeros(self.capacity, np.float32)
+        state = logic.init_state(jax.random.PRNGKey(0))
+        for batch in self.batches():
+            ids = np.asarray(logic.keys(batch))
+            pulled = table[ids]
+            state, req, _out = step(
+                state, dict(batch), jnp.asarray(pulled)
+            )
+            mask = None if req.mask is None else np.asarray(req.mask)
+            uids, rows = aggregate_deltas(
+                np.asarray(req.ids), np.asarray(req.deltas), mask
+            )
+            table[uids] += rows.astype(np.float32)
+        return table
+
+    def streaming_driver_values(self) -> np.ndarray:
+        """The literal StreamingDriver run on the same stream — the
+        fp32-semantics anchor :meth:`oracle_values` is pinned allclose
+        against (see its docstring for why the bitwise bar uses the
+        sequential loop instead)."""
+        from ..core.store import ShardedParamStore
+        from ..training.driver import DriverConfig, StreamingDriver
+        from ..utils.initializers import zeros
+
+        store = ShardedParamStore.create(
+            self.capacity, (), init_fn=zeros(())
+        )
+        driver = StreamingDriver(
+            self.make_logic(), store,
+            config=DriverConfig(telemetry=False, dump_model=False),
+        )
+        result = driver.run(self.batches())
+        return np.asarray(result.store.values())
+
+    # -- serving -------------------------------------------------------------
+    @staticmethod
+    def _parse_examples(arg: str):
+        """``id:val,id:val;id:val...`` → list of (ids, vals) arrays."""
+        examples = []
+        for part in arg.strip().split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            ids, vals = [], []
+            for tok in part.split(","):
+                fid, sep, val = tok.partition(":")
+                if not sep:
+                    raise ValueError(
+                        f"feature {tok!r}: expected <id>:<value>"
+                    )
+                ids.append(int(fid))
+                vals.append(float(val))
+            if not ids:
+                raise ValueError("empty example")
+            examples.append(
+                (np.asarray(ids, np.int64), np.asarray(vals, np.float32))
+            )
+        if not examples:
+            raise ValueError(
+                "predict needs id:val[,id:val...][;example...]"
+            )
+        return examples
+
+    def serve(self, client, cmd: str, arg: str) -> str:
+        if cmd != "predict":
+            return super().serve(client, cmd, arg)
+        examples = self._parse_examples(arg)
+        all_ids = np.unique(np.concatenate([ids for ids, _ in examples]))
+        if all_ids.min() < 0 or all_ids.max() >= self.capacity:
+            raise ValueError(
+                f"feature ids must be in [0, {self.capacity})"
+            )
+        w = np.asarray(
+            client.pull_batch(all_ids), np.float32
+        ).reshape(-1)
+        margins = []
+        for ids, vals in examples:
+            margins.append(
+                float(w[np.searchsorted(all_ids, ids)] @ vals)
+            )
+        return ",".join(f"{m:.6g}" for m in margins)
+
+    def probe_request(self, rng: np.random.Generator
+                      ) -> Tuple[str, str]:
+        F = self.capacity
+        k = min(3, F)
+        parts = []
+        for _ in range(2):
+            ids = rng.choice(F, size=k, replace=False)
+            vals = rng.standard_normal(k)
+            parts.append(",".join(
+                f"{int(i)}:{v:.4f}" for i, v in zip(ids, vals)
+            ))
+        return "predict", ";".join(parts)
+
+
+__all__ = ["PAClassifierWorkload"]

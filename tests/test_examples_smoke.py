@@ -1,10 +1,12 @@
 """Smoke tests for the runnable examples (the reference's L5 apps).
 
 Runs the examples as real subprocesses — the exact user surface — so
-example bit-rot fails CI.  All eight examples are covered: the seven
-fast ones per-commit, the slow one (hybrid_migration, ~2.5 min on this
-1-core host) behind ``FPS_ALL_EXAMPLES=1`` so per-commit cost stays low
-while the verify workflow exercises the full set.
+example bit-rot fails CI.  All examples are covered (the PA and
+sketches examples twice: their single-process default AND their
+``--serve`` registry/cluster path, workloads/); the slow one
+(hybrid_migration, ~2.5 min on this 1-core host) stays behind
+``FPS_ALL_EXAMPLES=1`` so per-commit cost stays low while the verify
+workflow exercises the full set.
 """
 import os
 import subprocess
@@ -47,11 +49,45 @@ def test_mf_example_with_args():
     assert "train RMSE" in r.stdout
 
 
+def test_passive_aggressive_example_cluster_serve():
+    """The registry path: --serve runs the PA workload on a live
+    2-shard cluster (bitwise parity enforced in the example itself)
+    and answers `predict` margins over the TCP verb endpoint."""
+    r = _run(
+        [
+            os.path.join(
+                "examples", "passive_aggressive_classification.py"
+            ),
+            "--serve", "--rounds", "10", "--batch", "64",
+            "--features", "48",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "bitwise parity vs streaming: True" in r.stdout
+    assert "served margins" in r.stdout
+
+
 def test_streaming_sketches_example():
     r = _run([os.path.join("examples", "streaming_sketches.py")])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "count-min hottest words" in r.stdout
     assert "F2 estimate" in r.stdout
+
+
+def test_streaming_sketches_example_cluster_serve():
+    """The registry path: --serve runs the count-min workload on a
+    live 2-shard cluster (integer-exact counts enforced in the
+    example) and answers query/topk over the TCP verb endpoint."""
+    r = _run(
+        [
+            os.path.join("examples", "streaming_sketches.py"),
+            "--serve", "--rounds", "12", "--batch", "256",
+            "--vocab", "128",
+        ]
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "integer-exact vs ground truth: True" in r.stdout
+    assert "served top-4" in r.stdout
 
 
 def test_topk_recommendation_example():

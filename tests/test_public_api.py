@@ -80,7 +80,8 @@ MODULE_SYMBOLS = {
         "register_cache", "unregister_cache", "cache_snapshots",
         "split_response_options", "parse_inv_token"],
     "flink_parameter_server_tpu.nemesis.invariants": [
-        "check_lease_staleness"],
+        "check_lease_staleness", "check_parity_bitwise",
+        "check_count_parity"],
     "flink_parameter_server_tpu.training.driver": ["TrainingDiverged"],
     "flink_parameter_server_tpu.models.matrix_factorization": [
         "SGDUpdater", "OnlineMatrixFactorization", "MFWorkerLogic",
@@ -160,6 +161,13 @@ MODULE_SYMBOLS = {
         "ServingService", "ServingClient", "ServingServer",
         "tcp_request", "parse_response", "format_response"],
     "flink_parameter_server_tpu.serving.metrics": ["ServingMetrics"],
+    "flink_parameter_server_tpu.workloads": [
+        "Workload", "WorkloadParams", "WorkloadRegistry",
+        "DenseCombineLogic", "create_workload", "workload_names",
+        "get_workload_registry", "build_cluster_driver",
+        "resolve_workload", "serve_workload", "workload_table",
+        "run_streaming", "WorkloadServingServer",
+        "WorkloadServingClient"],
 }
 
 

@@ -66,7 +66,8 @@ from typing import Any, Iterable, List, Tuple
 KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
-     "replication", "nemesis", "hotcache", "loadgen", "compression"}
+     "replication", "nemesis", "hotcache", "loadgen", "compression",
+     "workloads"}
 )
 
 

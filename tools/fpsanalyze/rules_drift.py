@@ -101,12 +101,18 @@ def default_drift_config(root: str) -> DriftConfig:
                 # examples/tests, not production emitters
                 ("docs/serving.md", "wire-verbs serving"),
             ),
+            WireSurface(
+                "workloads",
+                (f"{pkg}/workloads/serving.py", "_admit"),
+                [f"{pkg}/workloads/serving.py"],
+                ("docs/workloads.md", "wire-verbs workloads"),
+            ),
         ],
         metric_doc_files=docs,
         catalog_doc_files=[
             "docs/observability.md", "docs/cluster.md",
             "docs/elastic.md", "docs/loadgen.md",
-            "docs/compression.md",
+            "docs/compression.md", "docs/workloads.md",
         ],
         known_components=KNOWN_COMPONENTS,
         metric_scan_prefixes=[pkg + "/"],

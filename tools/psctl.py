@@ -21,6 +21,8 @@ Usage::
                  [--json]
     psctl bytes  --metrics HOST:PORT [--interval 2] [--iterations 0]
                  [--json]
+    psctl workloads --metrics HOST:PORT [--interval 2]
+                 [--iterations 0] [--json]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -66,6 +68,14 @@ The per-connection ``ratio`` column applies the fleet-measured ratio
 of that connection's last payload encoding (exact per-conn byte
 splits are not tracked — the enc column says which arm the conn is
 on, the counters say what the arm saves).
+
+``workloads`` is the per-workload rate table (docs/workloads.md): one
+row per registered workload with updates/sec, predictions/sec, sketch
+queries/sec and topk/sec derived from the ``workloads`` telemetry
+path's cumulative counters between scrapes, plus the serving-verb
+latency percentiles (``fps_workload_query_latency_seconds``) and
+serving errors.  The first frame shows cumulative totals (in
+parentheses) until a second scrape makes rates derivable.
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -401,6 +411,73 @@ def cmd_hot(args) -> int:
         if not args.raw:
             sys.stdout.write("\x1b[2J\x1b[H")
         print(screen, flush=True)
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_workloads(args) -> int:
+    """Live per-workload rate table: updates/sec, predictions/sec,
+    sketch queries/sec + query latency percentiles, diffed between
+    scrapes of the TelemetryServer ``workloads`` path
+    (workloads/runtime.workload_table)."""
+    host, port = parse_addr(args.metrics)
+    prev: Dict[str, dict] = {}
+    prev_t: Optional[float] = None
+    shown = 0
+    rate_keys = (
+        ("updates_total", "upd/s"),
+        ("predictions_total", "pred/s"),
+        ("queries_total", "query/s"),
+        ("topk_total", "topk/s"),
+    )
+    while True:
+        try:
+            doc = json.loads(scrape(host, port, "workloads"))
+        except (OSError, ValueError) as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        table = doc.get("workloads", {})
+        if args.json:
+            print(json.dumps(table, indent=2, sort_keys=True))
+            return 0
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        rows = []
+        for name in sorted(table):
+            row = table[name]
+            cells = [name]
+            for key, _label in rate_keys:
+                cur = int(row.get(key, 0))
+                if dt and name in prev:
+                    rate = (cur - int(prev[name].get(key, 0))) / dt
+                    cells.append(f"{rate:.1f}")
+                else:
+                    cells.append(f"({cur})")  # totals until 2nd frame
+            cells.append(str(row.get("query_latency_p50_ms", "—")))
+            cells.append(str(row.get("query_latency_p99_ms", "—")))
+            cells.append(str(row.get("serving_errors_total", 0)))
+            rows.append(cells)
+        lines = [
+            f"psctl workloads — {host}:{port} — rates per second "
+            f"(first frame shows cumulative totals in parentheses)",
+        ]
+        if rows:
+            lines.append("")
+            lines.append(_render_table(
+                ["workload"] + [lab for _, lab in rate_keys]
+                + ["q p50 ms", "q p99 ms", "serve errs"],
+                rows,
+            ))
+        else:
+            lines.append("(no workload instruments registered yet)")
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        prev, prev_t = table, now
         shown += 1
         if args.iterations and shown >= args.iterations:
             return 0
@@ -748,6 +825,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     by.add_argument("--json", action="store_true",
                     help="emit the raw payload once")
     by.set_defaults(fn=cmd_bytes)
+
+    wl = sub.add_parser(
+        "workloads",
+        help="live per-workload rate table (updates/predictions/"
+             "queries per second + query latency)",
+    )
+    wl.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    wl.add_argument("--interval", type=float, default=2.0)
+    wl.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = forever)")
+    wl.add_argument("--raw", action="store_true",
+                    help="no screen clear (pipe/CI friendly)")
+    wl.add_argument("--json", action="store_true",
+                    help="emit the raw payload once")
+    wl.set_defaults(fn=cmd_workloads)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
