@@ -602,9 +602,9 @@ class ClusterClient(ParameterServerClient):
                 f"wire_format={wire_format!r}: "
                 f"'text' | 'b64' | 'bf16' | 'q8'"
             )
-        if wire_proto not in ("auto", "line"):
+        if wire_proto not in ("auto", "line", "shm"):
             raise ValueError(
-                f"wire_proto={wire_proto!r}: 'auto' | 'line'"
+                f"wire_proto={wire_proto!r}: 'auto' | 'line' | 'shm'"
             )
         self.membership = membership
         self.hedge = hedge
@@ -620,7 +620,11 @@ class ClusterClient(ParameterServerClient):
         # round trip at dial time; an old server's err bad-request
         # downgrades that connection to the line protocol).  "line":
         # never negotiate — bit-for-bit the pre-binary client, the
-        # compat baseline the cross-version tests pin.
+        # compat baseline the cross-version tests pin.  "shm": attempt
+        # the shared-memory hello against co-located shards (shmem/),
+        # falling back per connection to binary TCP (then lines) for
+        # non-local peers, old servers, or a proxied path — each
+        # fallback counted in shmem_fallbacks_total.
         self._wire_proto = wire_proto
         # spawn grace (cluster/procs.py): a just-spawned shard process
         # may not have bound yet when its first dial arrives — retry
@@ -839,13 +843,38 @@ class ClusterClient(ParameterServerClient):
             time.monotonic() + self._spawn_grace_s
             if self._spawn_grace_s > 0 else None
         )
+        use_shm = False
+        if self._wire_proto == "shm":
+            # shared memory only reaches co-located peers; a remote
+            # address is a not-local fallback before any segment exists
+            from ..shmem.channel import shm_usable
+
+            use_shm = shm_usable(addr[0])
+            if not use_shm:
+                from ..shmem.metrics import count_fallback
+
+                count_fallback(
+                    "not-local",
+                    registry=self._reg if self._reg is not None else False,
+                )
         while True:
             try:
+                if use_shm:
+                    from ..shmem.channel import ShmShardConnection
+
+                    return ShmShardConnection(
+                        addr[0], addr[1], window=self._window,
+                        timeout=self._timeout,
+                        connect_timeout=self._connect_timeout,
+                        registry=(
+                            self._reg if self._reg is not None else False
+                        ),
+                    )
                 return ShardConnection(
                     addr[0], addr[1], window=self._window,
                     timeout=self._timeout,
                     connect_timeout=self._connect_timeout,
-                    negotiate=self._wire_proto == "auto",
+                    negotiate=self._wire_proto in ("auto", "shm"),
                 )
             except ConnectionRefusedError:
                 if deadline is None or time.monotonic() >= deadline:
@@ -1483,7 +1512,7 @@ class ClusterClient(ParameterServerClient):
         reject_reason = "reject"
 
         def build(conn) -> List:
-            if conn.proto == "bin":
+            if conn.proto != "line":  # bin or shm: same frames
                 enc = self._bin_enc()
                 tlvs = self._bin_tlvs(tok)
                 lease_tlvs = [
@@ -1652,7 +1681,7 @@ class ClusterClient(ParameterServerClient):
             (raw i8 ids + fp32/bf16 rows, options as TLVs) on a
             negotiated connection, text lines otherwise."""
             t_ser = time.perf_counter()
-            if conn.proto == "bin":
+            if conn.proto != "line":  # bin or shm: same frames
                 enc = self._bin_enc()
                 tlvs = self._bin_tlvs(tok)
                 reqs = [
@@ -1748,7 +1777,7 @@ class ClusterClient(ParameterServerClient):
 
         def build(conn) -> List:
             t_ser = time.perf_counter()
-            if conn.proto == "bin":
+            if conn.proto != "line":  # bin or shm: same frames
                 tlvs = self._bin_tlvs(tok, pid)
                 if q_rows is not None and "q8" in conn.encs:
                     # the quantized push path: int8 rows + a T_SCALE

@@ -101,7 +101,11 @@ class ClusterConfig:
     # framing"): "auto" negotiates the length-prefixed binary frame
     # per connection (one hello round trip; old servers answer err
     # bad-request and the connection stays on the line protocol);
-    # "line" never negotiates — the pre-binary client, byte for byte
+    # "line" never negotiates — the pre-binary client, byte for byte;
+    # "shm" additionally attempts the shared-memory ring transport
+    # (shmem/, docs/shmem.md) against co-located shards, falling back
+    # per connection to binary TCP (then lines) for non-local peers,
+    # old servers, or a proxied path
     wire_proto: str = "auto"
     # shard worker PROCESSES (cluster/procs.py): each shard server in
     # its own spawned process — its own GIL — with the numpy store

@@ -1122,11 +1122,16 @@ class ShardServer(LineServer):
         tracer=None,
         profiler=None,
         overload=None,
+        enable_shm: bool = True,
     ):
         super().__init__(
             host, port, name=f"shard-{shard.shard_id}",
             max_line_bytes=max_line_bytes,
         )
+        # accept "hello shm v=1" (shmem/): co-located clients hand the
+        # data plane to a shared-memory ring pair; False answers the
+        # downgrade err and every client falls back to binary TCP
+        self.shm_enabled = bool(enable_shm)
         self.shard = shard
         self.supervised = supervised
         # overload-plane admission (loadgen/overload.OverloadGuard):
@@ -1315,6 +1320,9 @@ class ShardServer(LineServer):
                 # clients check the "ok proto=bin" prefix only, new
                 # clients downgrade unadvertised encodings to f32
                 return binf.hello_ok_line()
+            # "hello shm" lands here only when shm is DISABLED (the
+            # enabled path is intercepted in LineServer._serve_one) —
+            # the err answer is what drives the client's TCP fallback
             raise ValueError(
                 f"unknown protocol {' '.join(toks[1:])!r} (try: bin)"
             )

@@ -242,6 +242,9 @@ class ChaosProxy(LineServer):
         self._heal_timers: List[threading.Timer] = []
         self._registry = registry
         self._fault_counters: Dict[str, object] = {}
+        # shm hellos refused at the splice point (_relay_frame): each
+        # one is a client downgraded to binary TCP through this link
+        self.shm_downgrades = 0
 
     # -- fault accounting --------------------------------------------------
     def _count_fault(self, kind: str, n: int = 1) -> None:
@@ -463,6 +466,27 @@ class ChaosProxy(LineServer):
     def _relay_frame(
         self, frame: bytes, direction: str, ctx: dict, src, dst
     ) -> None:
+        if (
+            direction == "c2s"
+            and not binframes.peek_is_binary(frame)
+            and frame[:9].lower() == b"hello shm"
+        ):
+            # the shm splice point (docs/resilience.md): shared-memory
+            # segments cannot be routed through a TCP relay, so a
+            # proxied link REFUSES the shm hello here — the client's
+            # standard downgrade path renegotiates binary on this same
+            # connection and every fault class below then applies to
+            # all of its traffic.  Letting the hello through would
+            # negotiate a side channel the proxy never sees.
+            self.shm_downgrades += 1
+            try:
+                src.sendall(
+                    b"err bad-request: shm not routable through a "
+                    b"proxied link\n"
+                )
+            except OSError:
+                pass
+            return
         eng = self.engine
         shot = eng.take_one_shot(direction)
         if shot is not None:

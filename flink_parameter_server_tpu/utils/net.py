@@ -203,12 +203,14 @@ class ConnStats:
     own dispatcher/handler thread; read by
     :meth:`LineServer.conn_table`).  ``proto`` is the negotiated
     framing (``line`` until a successful binary hello), ``enc`` the
-    last payload encoding seen on a binary frame — the two columns
-    that make a mixed-version fleet visible in ``psctl conns``."""
+    last payload encoding seen on a binary frame, ``wire`` the
+    substrate under it (``tcp``, or ``shm`` after a shared-memory
+    hello handed the data plane to a ring pair) — the columns that
+    make a mixed-version fleet visible in ``psctl conns``."""
 
     __slots__ = (
         "peer", "connected_at", "bytes_in", "bytes_out",
-        "frames_in", "frames_out", "last_verb", "proto", "enc",
+        "frames_in", "frames_out", "last_verb", "proto", "enc", "wire",
     )
 
     def __init__(self, peer: str):
@@ -221,6 +223,7 @@ class ConnStats:
         self.last_verb = ""
         self.proto = "line"
         self.enc = ""
+        self.wire = "tcp"
 
     def as_dict(self) -> dict:
         return {
@@ -233,6 +236,7 @@ class ConnStats:
             "last_verb": self.last_verb,
             "proto": self.proto,
             "enc": self.enc,
+            "wire": self.wire,
         }
 
 
@@ -245,7 +249,7 @@ class _ConnState:
 
     __slots__ = (
         "sock", "stats", "buf", "queue", "cond", "eof", "closed",
-        "owned", "dispatcher_started", "overflow",
+        "owned", "dispatcher_started", "overflow", "shm",
     )
 
     def __init__(self, sock: socket.socket, stats: ConnStats):
@@ -262,6 +266,10 @@ class _ConnState:
         self.owned = False
         self.dispatcher_started = False
         self.overflow: Optional[str] = None  # "line" | "bin" | None
+        # the shm pump once a shared-memory hello handed this
+        # connection's data plane to a ring pair (the TCP socket stays
+        # as the liveness anchor); stopped by _close_state
+        self.shm = None
 
 
 class LineServer:
@@ -278,6 +286,12 @@ class LineServer:
     # connection idle past it costs a selector entry instead of a
     # blocked thread.  See _linger_read.
     LINGER_S = 0.5
+
+    # borrow-reclaim lease for shm channels: a pump blocked writing
+    # into a full response ring reclaims once the client heartbeat has
+    # been silent this long (reader-crash-while-borrowing — a LIVE
+    # client keeps beating and is never reclaimed).  See shmem/pump.py.
+    SHM_RECLAIM_S = 5.0
 
     def __init__(
         self,
@@ -310,6 +324,10 @@ class LineServer:
         # threshold — the io loop re-registers them each tick
         self._resume: Deque[_ConnState] = collections.deque()
         self.connections_accepted = 0  # lifetime count (observability)
+        # opt-in per subclass (ShardServer flips it): a server that
+        # never opts in answers the shm hello with the same err
+        # bad-request an old server would — the downgrade path
+        self.shm_enabled = False
 
     def live_connections(self) -> int:
         """Currently-open connections (the lifetime count is
@@ -736,7 +754,17 @@ class LineServer:
         stats.bytes_in += len(data) + 1
         stats.frames_in += 1
         self.meter.count("in", verb, len(data) + 1)
-        resp = self.respond(line)
+        resp = None
+        if verb == "hello":
+            # the shm hello is a TRANSPORT negotiation, handled here
+            # rather than in respond(): on success this connection's
+            # data plane moves to a ring pair and the socket becomes
+            # the liveness anchor.  None = not an shm hello (or shm
+            # disabled) — falls through to respond(), whose unknown-
+            # protocol err is the downgrade path old servers take.
+            resp = self._maybe_shm_hello(st, line)
+        if resp is None:
+            resp = self.respond(line)
         if resp is not None:
             payload = resp.encode("utf-8") + b"\n"
             stats.bytes_out += len(payload)
@@ -750,11 +778,53 @@ class LineServer:
                 stats.proto = "bin"
         return True
 
+    def _maybe_shm_hello(self, st: _ConnState, line: str) -> Optional[str]:
+        """Negotiate ``hello shm v=1 c2s=<seg> s2c=<seg>``: attach the
+        client-created segments and start the pump (shmem/pump.py).
+        Returns the answer line, or ``None`` when the line is not an
+        shm hello / shm is not enabled (caller falls through to
+        ``respond()``).  Any failure answers ``err`` — the client
+        tears its segments down and renegotiates binary on this same
+        connection, so a refusal is never fatal."""
+        toks = line.split()
+        if len(toks) < 2 or toks[0].lower() != "hello" \
+                or toks[1].lower() != "shm":
+            return None
+        if not self.shm_enabled:
+            return None  # respond() answers err unknown-protocol
+        opts = {}
+        for tok in toks[2:]:
+            if "=" in tok:
+                k, _, v = tok.partition("=")
+                opts[k.lower()] = v
+        if opts.get("v") != "1":
+            return f"err bad-request: shm version {opts.get('v')!r}"
+        c2s, s2c = opts.get("c2s"), opts.get("s2c")
+        if not c2s or not s2c:
+            return "err bad-request: shm hello needs c2s= and s2c="
+        try:
+            from ..shmem.pump import ShmServerPump
+
+            pump = ShmServerPump(self, st, c2s, s2c)
+        except Exception as exc:  # noqa: BLE001 — refusal, not death
+            return f"err bad-request: shm attach failed: {exc}"
+        st.shm = pump
+        st.stats.proto = "shm"
+        st.stats.wire = "shm"
+        pump.start()
+        return "ok proto=shm v=1 enc=" + ",".join(binframes.WIRE_ENCS)
+
     def _close_state(self, st: _ConnState) -> None:
         with st.cond:
             if st.closed:
                 return
             st.closed = True
+            pump = st.shm
+        if pump is not None:
+            # wake the pump out of any ring wait; it observes
+            # st.closed and folds (its own teardown re-enters here and
+            # no-ops on the guard above)
+            pump.stop()
         try:
             st.sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -48,3 +48,17 @@ def mesh():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Skip @pytest.mark.shmem tests on hosts without usable POSIX
+    shared memory (no /dev/shm, or not writable) — the shm transport
+    itself falls back to TCP there, so there is nothing to test."""
+    from flink_parameter_server_tpu.shmem import available
+
+    if available():
+        return
+    skip = pytest.mark.skip(reason="no writable /dev/shm on this host")
+    for item in items:
+        if "shmem" in item.keywords:
+            item.add_marker(skip)

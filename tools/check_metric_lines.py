@@ -67,7 +67,7 @@ KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
      "replication", "nemesis", "hotcache", "loadgen", "compression",
-     "workloads"}
+     "workloads", "shmem"}
 )
 
 

@@ -335,10 +335,12 @@ def cmd_conns(args) -> int:
         print(f"{addr}: {len(conns)} connection(s)")
         rows = [
             [c.get("peer", "?"), f"{c.get('age_s', 0):.1f}s",
-             # negotiated framing + last payload encoding: the two
-             # columns that make a mixed line/binary fleet visible
-             # mid-rollout (utils/net.py ConnStats)
-             c.get("proto", "line"), c.get("enc", "") or "-",
+             # negotiated framing, wire substrate (tcp | shm), last
+             # payload encoding: the columns that make a mixed
+             # line/binary/shared-memory fleet visible mid-rollout
+             # (utils/net.py ConnStats; pre-shmem servers omit wire)
+             c.get("proto", "line"), c.get("wire", "tcp"),
+             c.get("enc", "") or "-",
              _fmt_bytes(c.get("bytes_in", 0)),
              _fmt_bytes(c.get("bytes_out", 0)),
              str(c.get("frames_in", 0)), str(c.get("frames_out", 0)),
@@ -347,7 +349,7 @@ def cmd_conns(args) -> int:
         ]
         if rows:
             print(_render_table(
-                ["peer", "age", "proto", "enc", "bytes in",
+                ["peer", "age", "proto", "wire", "enc", "bytes in",
                  "bytes out", "frames in", "frames out", "last verb"],
                 rows,
             ))
