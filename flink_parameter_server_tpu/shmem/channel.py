@@ -26,10 +26,24 @@ Zero-copy pulls: a ``K_FRAME`` response decodes via
 payloads ``np.frombuffer`` out of shared memory with no wire copy at
 all.  The borrow protocol pays for it: views stay valid until
 :meth:`release` (called automatically at the next ``request_many``),
-and one batch's responses must fit the ring (``DEFAULT_CAPACITY``
-4 MiB; the cluster client's chunked builders stay well under).  While
-anything is borrowed the server pump physically cannot overwrite it —
-a full ring blocks the producer (ring.py).
+and while anything is borrowed the server pump physically cannot
+overwrite it — a full ring blocks the producer (ring.py).  A batch
+whose responses OUTGROW the ring (``DEFAULT_CAPACITY`` 4 MiB; the
+cluster client's chunked builders stay well under) does not wedge
+that producer: past a high-water mark — or the moment a response
+wait stalls with borrows outstanding — the channel SPILLS, copying
+every frame handed out so far off the ring and releasing, so the
+pump regains the whole ring mid-batch (``shmem_borrow_spills_total``;
+spilled frames lose zero-copy, never correctness).
+
+Oversize requests — legal over TCP (the 64 MiB ``max_line_bytes``
+bound) but bigger than a ring record may be (``ring.max_record``,
+half the capacity) — DETOUR over the TCP anchor: the channel drains
+its in-flight ring responses first, then runs that one request
+synchronously through the ordinary socket path (the server's
+dispatcher still serves the anchor), so ordering holds and the
+channel stays on shm for everything that fits
+(``shmem_fallbacks_total{reason="oversize"}``).
 
 Liveness, both directions: a beat thread bumps the c2s heartbeat
 ~every 50 ms (the server's borrow-reclaim lease, pump.py); the abort
@@ -125,7 +139,15 @@ class ShmShardConnection(ShardConnection):
         self._registry = registry
         self.wire = "tcp"
         self.borrows = 0
+        self.spills = 0
         self._c_borrows = None
+        self._c_spills = None
+        # zero-copy frames handed out of the response ring THIS batch
+        # — the set a mid-batch spill must materialize before it may
+        # release the ring under them
+        self._borrows_open: List = []
+        self._max_payload = 0
+        self._spill_hiwater = 0
         self._c2s: Optional[ShmRing] = None
         self._s2c: Optional[ShmRing] = None
         self._bell_out: Optional[Doorbell] = None
@@ -168,6 +190,11 @@ class ShmShardConnection(ShardConnection):
             self._negotiate()
             return
         self._c2s, self._s2c = c2s, s2c
+        self._max_payload = c2s.max_record
+        # spill past half the response ring: keeps the pump's worst
+        # remaining produce well inside the free half even before the
+        # stall path kicks in
+        self._spill_hiwater = s2c.capacity // 2
         self.proto = "shm"
         self.wire = "shm"
         self.encs = binf.hello_encs(resp)
@@ -182,6 +209,10 @@ class ShmShardConnection(ShardConnection):
                 reg = registry if registry is not None else get_registry()
                 self._c_borrows = reg.counter(
                     "shmem_borrows_total", component="shmem", role="client"
+                )
+                self._c_spills = reg.counter(
+                    "shmem_borrow_spills_total", component="shmem",
+                    role="client",
                 )
             except Exception:  # accounting never fails the transport
                 pass
@@ -240,11 +271,32 @@ class ShmShardConnection(ShardConnection):
         earlier batches is dead to the caller and its bytes are the
         server's again.  ``request_many`` calls this at batch start —
         the borrow window IS the gap between batches."""
+        self._borrows_open.clear()
         if self._s2c is not None:
             try:
                 self._s2c.release()
             except (TypeError, ValueError):
                 pass
+
+    def _spill_borrows(self) -> None:
+        """Materialize every zero-copy frame handed out this batch —
+        copy its payload off the ring — then release, handing the
+        whole ring back to the pump MID-batch.  The escape hatch that
+        lets a batch's responses outgrow the ring: spilled frames pay
+        one copy (exactly what TCP pays per byte anyway), callers
+        see identical Frames."""
+        for f in self._borrows_open:
+            f.payload = memoryview(bytes(f.payload))
+            if f.ids is not None and not f.ids.flags["OWNDATA"]:
+                f.ids = f.ids.copy()
+        self._borrows_open.clear()
+        try:
+            self._s2c.release()
+        except (TypeError, ValueError):
+            return
+        self.spills += 1
+        if self._c_spills is not None:
+            self._c_spills.inc()
 
     def request_many(self, lines: Sequence) -> List:
         if self.proto != "shm":
@@ -253,12 +305,11 @@ class ShmShardConnection(ShardConnection):
         out: List = []
         pending = 0
         pending_meta: List[Tuple[str, str]] = []  # (framing, verb)
-        it = iter(lines)
         sent = 0
         total = len(lines)
         while sent < total or pending:
             while pending < self.window and sent < total:
-                req = next(it)
+                req = lines[sent]
                 if isinstance(req, (bytes, bytearray, memoryview)):
                     payload = bytes(req)
                     verb = binf.peek_verb_name(payload)
@@ -269,7 +320,30 @@ class ShmShardConnection(ShardConnection):
                     # +1 mirrors the TCP newline so net_bytes_total
                     # compares across wires
                     kind, wire_len = K_LINE, len(payload) + 1
-                self._produce(kind, payload)
+                if len(payload) > self._max_payload:
+                    # legal over TCP, too big for a ring record: the
+                    # TCP-anchor detour (module docstring).  Drain the
+                    # ring pipeline first so ordering holds, then run
+                    # this one request synchronously over the socket
+                    # (the parent path meters it itself).
+                    if pending:
+                        break
+                    count_fallback("oversize", registry=self._registry)
+                    out.append(super().request_many([req])[0])
+                    sent += 1
+                    continue
+                if pending and not self._produce(
+                    kind, payload, timeout=0.05
+                ):
+                    # request ring stalled with responses owed: the
+                    # pump may be write-blocked behind them (the
+                    # classic pipelining deadlock a kernel socket
+                    # buffer absorbs) — drain one response, which
+                    # spills-and-releases as needed, then retry this
+                    # same request
+                    break
+                if not pending:
+                    self._produce(kind, payload)
                 self._meter.count("out", verb, wire_len)
                 pending_meta.append(("bin" if kind == K_FRAME else "line",
                                      verb))
@@ -277,50 +351,80 @@ class ShmShardConnection(ShardConnection):
                 sent += 1
                 self.inflight = pending
                 self.requests_sent += 1
-            _framing, verb = pending_meta.pop(0)
-            out.append(self._consume_one(verb))
-            pending -= 1
-            self.inflight = pending
+            if pending:
+                _framing, verb = pending_meta.pop(0)
+                out.append(self._consume_one(verb))
+                pending -= 1
+                self.inflight = pending
         return out
 
-    def _produce(self, kind: int, payload: bytes) -> None:
+    def _produce(
+        self, kind: int, payload: bytes,
+        *, timeout: Optional[float] = None,
+    ) -> bool:
+        """Append one request record.  With the default (full-budget)
+        timeout a stall raises ``socket.timeout``; with an explicit
+        short ``timeout`` a stall returns False instead, so the send
+        loop can drain a response and retry (the pipelining-deadlock
+        valve)."""
         try:
             self._c2s.produce(
-                kind, payload, timeout=self._timeout_s,
+                kind, payload,
+                timeout=self._timeout_s if timeout is None else timeout,
                 should_abort=self._abort, waiter=self._bell_out.wait,
             )
+            return True
         except RingClosed:
             raise self._dead("request") from None
         except RingTimeout:
             if self._peer_dead:
                 raise self._dead("request") from None
+            if timeout is not None:
+                return False
             raise socket.timeout(
                 f"shm ring to {self.host}:{self.port} full for "
                 f"{self._timeout_s}s"
             ) from None
 
     def _consume_one(self, verb: str):
-        try:
-            kind, view = self._s2c.consume(
-                timeout=self._timeout_s,
-                should_abort=self._abort, waiter=self._bell_in.wait,
-            )
-        except RingClosed:
-            raise self._dead("response") from None
-        except RingTimeout:
-            if self._peer_dead:
+        deadline = time.monotonic() + self._timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                if self._peer_dead:
+                    raise self._dead("response")
+                raise socket.timeout(
+                    f"no shm response from {self.host}:{self.port} in "
+                    f"{self._timeout_s}s"
+                )
+            # while our own borrows hold ring bytes, wait SHORT: a
+            # stalled response may mean the pump is write-blocked on
+            # the very bytes we are sitting on — spill-and-release
+            # un-wedges it (the incremental half of the borrow
+            # protocol); with nothing borrowed, wait the full budget
+            spillable = self._s2c.borrowed() > 0
+            try:
+                kind, view = self._s2c.consume(
+                    timeout=min(0.05, remaining) if spillable
+                    else remaining,
+                    should_abort=self._abort, waiter=self._bell_in.wait,
+                )
+                break
+            except RingClosed:
                 raise self._dead("response") from None
-            raise socket.timeout(
-                f"no shm response from {self.host}:{self.port} in "
-                f"{self._timeout_s}s"
-            ) from None
-        except RingCorruption:
-            # not retryable: a scribbled ring cannot be trusted for
-            # any in-flight response — surface as a dead peer so the
-            # elastic retry path re-dials (landing on TCP if shm is
-            # what's broken)
-            self._peer_dead = True
-            raise self._dead("response (ring corruption)") from None
+            except RingTimeout:
+                if self._peer_dead:
+                    raise self._dead("response") from None
+                if spillable:
+                    self._spill_borrows()
+                continue
+            except RingCorruption:
+                # not retryable: a scribbled ring cannot be trusted
+                # for any in-flight response — surface as a dead peer
+                # so the elastic retry path re-dials (landing on TCP
+                # if shm is what's broken)
+                self._peer_dead = True
+                raise self._dead("response (ring corruption)") from None
         if kind == K_LINE:
             text = bytes(view).decode("utf-8", "replace").rstrip("\n")
             self._meter.count("in", _safe_verb(text), len(view) + 1)
@@ -336,6 +440,13 @@ class ShmShardConnection(ShardConnection):
         if self._c_borrows is not None:
             self._c_borrows.inc()
         self._meter.count("in", frame.verb_name, len(view))
+        view = None
+        self._borrows_open.append(frame)
+        if self._s2c.borrowed() > self._spill_hiwater:
+            # proactive spill at the high-water mark: a batch whose
+            # responses outgrow the ring hands bytes back BEFORE the
+            # pump ever write-blocks on our borrows
+            self._spill_borrows()
         return frame
 
     # -- lifecycle ---------------------------------------------------------

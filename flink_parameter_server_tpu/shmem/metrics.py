@@ -44,10 +44,12 @@ def track_ring(role: str, direction: str, ring, registry=None) -> None:
 
 
 def count_fallback(reason: str, registry=None) -> None:
-    """One shm dial that landed on TCP instead —
+    """One shm dial — or one request — that landed on TCP instead:
     ``shmem_fallbacks_total{reason}`` (``hello-refused``: the peer
     declined or predates shm; ``attach-failed``: segment creation or
-    negotiation died; ``not-local``: the peer is not co-located)."""
+    negotiation died; ``not-local``: the peer is not co-located;
+    ``oversize``: a single request too big for a ring record took the
+    TCP-anchor detour while the channel stayed on shm)."""
     if registry is False:
         return
     try:
@@ -80,4 +82,29 @@ def count_reclaim(registry=None) -> None:
         pass
 
 
-__all__ = ["count_fallback", "count_reclaim", "track_ring"]
+def count_teardown(reason: str, registry=None) -> None:
+    """One server pump that folded its channel for ``reason`` —
+    ``shmem_pump_teardowns_total{reason}`` (``error``: the serve loop
+    caught an unexpected exception; the no-raise guarantee holds but
+    the fold must not be silent — without this counter a programming
+    error is indistinguishable from a dead peer, docs/shmem.md)."""
+    if registry is False:
+        return
+    try:
+        from ..telemetry.registry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        reg.counter(
+            "shmem_pump_teardowns_total", component="shmem",
+            reason=reason,
+        ).inc()
+    except Exception:
+        pass
+
+
+__all__ = [
+    "count_fallback",
+    "count_reclaim",
+    "count_teardown",
+    "track_ring",
+]

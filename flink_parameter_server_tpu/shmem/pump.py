@@ -29,13 +29,14 @@ backpressure.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 from ..utils import frames as binf
 from ..utils.net import _safe_verb
 from .doorbell import Doorbell
-from .metrics import count_reclaim, track_ring
+from .metrics import count_reclaim, count_teardown, track_ring
 from .ring import (
     K_FRAME,
     K_LINE,
@@ -44,6 +45,8 @@ from .ring import (
     RingTimeout,
     ShmRing,
 )
+
+logger = logging.getLogger(__name__)
 
 
 class ShmServerPump:
@@ -182,6 +185,19 @@ class ShmServerPump:
                     if payload is None:
                         continue
                     out_kind, wire_len = K_FRAME, len(payload)
+                if len(payload) > self.s2c.max_record:
+                    # a response legal over TCP (64 MiB max_line_bytes)
+                    # but bigger than a ring record may be: answer a
+                    # CLEAR protocol error instead of letting produce
+                    # raise (which would silently fold the channel) —
+                    # the client surfaces it as err bad-request
+                    payload = (
+                        f"err bad-request: {len(payload)}-byte response "
+                        f"exceeds shm ring record limit "
+                        f"({self.s2c.max_record}); re-chunk the request "
+                        f"or use wire_proto=auto"
+                    ).encode("utf-8")
+                    out_kind, wire_len = K_LINE, len(payload) + 1
                 # ledger BEFORE the hand-off, same as _serve_one
                 stats.bytes_out += wire_len
                 stats.frames_out += 1
@@ -197,7 +213,14 @@ class ShmServerPump:
                         count_reclaim(registry=self._registry)
                     return
         except Exception:  # noqa: BLE001 — a poisoned record must not
-            pass  # leak the channel; respond() itself never raises
+            # leak the channel (respond() itself never raises) — but a
+            # silent fold makes a programming error look like a dead
+            # peer: count and log the reason before folding
+            count_teardown("error", registry=self._registry)
+            logger.warning(
+                "%s: shm pump folding channel after unexpected error",
+                self.server.name, exc_info=True,
+            )
         finally:
             for r in (self.c2s, self.s2c):
                 try:

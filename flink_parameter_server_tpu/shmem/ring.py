@@ -207,8 +207,13 @@ class ShmRing:
     """One direction of a shm channel (see module docstring).
 
     ``capacity`` is the data-region size in bytes; a single record
-    (header + payload) must fit in ``capacity - REC_SIZE`` so a wrap
-    marker always has room."""
+    (header + payload) may claim at most ``capacity // 2`` so the
+    worst-case wrap (a ``K_WRAP`` marker skipping almost ``need``
+    bytes to the boundary, then the record itself) still fits an
+    otherwise EMPTY ring — a looser bound would admit records whose
+    wrap-adjusted footprint exceeds the ring and can never be
+    satisfied, deadlocking the producer (:attr:`max_record` is the
+    payload-byte ceiling callers size against)."""
 
     def __init__(
         self,
@@ -336,6 +341,17 @@ class ShmRing:
     def heartbeat(self) -> int:
         return _U64.unpack_from(self.buf, _OFF_HEARTBEAT)[0]
 
+    @property
+    def max_record(self) -> int:
+        """Largest payload :meth:`produce` accepts.  A record (header
+        + payload) may claim at most half the data region: when it
+        straddles the physical end, the wrap marker burns up to
+        ``need - 1`` bytes of skip on top of the record itself, so
+        only ``need <= capacity // 2`` guarantees the wrap-adjusted
+        footprint fits an empty ring (anything looser can deadlock —
+        the room() wait would never be satisfiable)."""
+        return self.capacity // 2 - REC_SIZE
+
     # -- observability -----------------------------------------------------
     def depth(self) -> int:
         """Live bytes between the published indices — the ring depth
@@ -365,10 +381,16 @@ class ShmRing:
         payload = memoryview(payload)
         need = REC_SIZE + payload.nbytes
         cap = self.capacity
-        if need > cap - REC_SIZE:
+        # the wrap bound, not the raw one: a record straddling the
+        # physical end pays a skip of up to need-1 bytes on top of
+        # itself, so need > cap//2 has alignments at which it can
+        # NEVER fit — rejected up front instead of waiting forever
+        if need > cap // 2:
             raise ValueError(
                 f"record of {payload.nbytes} bytes cannot fit a "
-                f"{cap}-byte ring (max {cap - 2 * REC_SIZE})"
+                f"{cap}-byte ring (max payload {self.max_record}: a "
+                f"record may claim at most half the ring so its "
+                f"worst-case wrap still fits)"
             )
 
         def room() -> Optional[Tuple[int, int]]:
@@ -384,6 +406,14 @@ class ShmRing:
                 total = to_end + need       # K_WRAP marker + record
             else:
                 total = need                # contiguous as-is
+            if total > cap:
+                # unreachable given the need <= cap//2 guard above —
+                # belt and braces against a future bound change: an
+                # unsatisfiable wait must raise, never hang
+                raise ValueError(
+                    f"record footprint {total} exceeds the {cap}-byte "
+                    f"ring at offset {off}"
+                )
             return total if free >= total else None
 
         self._wait(

@@ -37,7 +37,10 @@ import numpy as np
 import pytest
 
 from flink_parameter_server_tpu import telemetry as tm
-from flink_parameter_server_tpu.cluster.client import ClusterClient
+from flink_parameter_server_tpu.cluster.client import (
+    ClusterClient,
+    ShardConnection,
+)
 from flink_parameter_server_tpu.cluster.partition import RangePartitioner
 from flink_parameter_server_tpu.cluster.shard import ParamShard, ShardServer
 from flink_parameter_server_tpu.shmem.channel import (
@@ -211,6 +214,59 @@ class TestRing:
                 r.consume(timeout=0.5)
             with pytest.raises(RingClosed):
                 r.produce(K_FRAME, b"x")
+        finally:
+            r.close()
+            r.unlink()
+
+    def test_half_ring_record_rejected_not_deadlocked(self):
+        """The wrap-slack bound: a record over capacity//2 has
+        alignments at which its K_WRAP skip + body exceed the ring,
+        so the room() wait can NEVER be satisfied — it must raise
+        ValueError up front, not block an EMPTY ring until timeout
+        (an 892-byte payload at offset 200 of a 1024-byte ring needs
+        824 skip + 900 record = 1724 > 1024 contiguous-equivalent)."""
+        r = ShmRing.create(1024)
+        try:
+            # walk the write position to offset 200
+            r.produce(K_FRAME, b"a" * 192, timeout=1.0)
+            _, view = r.consume(timeout=1.0)
+            view = None
+            r.release()
+            assert r._wpos % r.capacity == 200
+            t0 = time.monotonic()
+            with pytest.raises(ValueError):
+                r.produce(K_FRAME, b"x" * 892, timeout=5.0)
+            assert time.monotonic() - t0 < 1.0, (
+                "oversize record waited instead of raising"
+            )
+            # the ring is still healthy for legal records
+            assert r.max_record == 1024 // 2 - 8
+            r.produce(K_FRAME, b"y" * r.max_record, timeout=1.0)
+            _, view = r.consume(timeout=1.0)
+            assert bytes(view) == b"y" * r.max_record
+            view = None
+            r.release()
+        finally:
+            r.close()
+            r.unlink()
+
+    def test_max_record_fits_at_every_alignment(self):
+        """A max_record payload must ALWAYS fit an empty ring, at any
+        write offset: alternating 1-byte and max-size records walks
+        the offset 9+136 bytes per round through a 256-byte ring, so
+        every wrap alignment (marker and implicit skip) is crossed
+        without a single produce blocking."""
+        r = ShmRing.create(256)
+        try:
+            big = r.max_record  # 120
+            for i in range(60):
+                for payload in (bytes([i % 251]), b"z" * big):
+                    r.produce(K_FRAME, payload, timeout=1.0)
+                    _, view = r.consume(timeout=1.0)
+                    assert bytes(view) == payload, f"round {i}"
+                    view = None
+                    r.release()
+            assert r._wpos > 4 * 256  # really lapped the ring
         finally:
             r.close()
             r.unlink()
@@ -416,6 +472,161 @@ class TestBorrowReclaim:
             assert fresh_registry.counter(
                 "shmem_borrow_reclaims_total", component="shmem",
                 role="server",
+            ).value >= 1
+        finally:
+            if conn is not None:
+                conn.close()
+            for s in servers:
+                s.stop()
+
+
+class TestSizing:
+    """Frames legal over TCP but bigger than the ring (or a batch of
+    responses bigger than the ring) must NEVER wedge or silently fold
+    a channel — the detour/spill/protocol-error escape hatches."""
+
+    def _pull(self, n, start=0):
+        return binf.encode_request(
+            binf.VERB_IDS["pull"],
+            ids=np.arange(start, start + n, dtype=np.int64),
+        )
+
+    def _rows(self, frame, dim=4):
+        return binf.rows_from_payload(frame.payload, (dim,), frame.enc)
+
+    def test_oversize_request_detours_over_tcp(self, fresh_registry):
+        """A request over ring.max_record rides the TCP anchor —
+        strictly ordered with the ring pipeline around it — and the
+        channel stays on shm for everything that fits."""
+        part, shards, servers, addrs = _mini_cluster(
+            n_shards=1, capacity=512
+        )
+        conn = None
+        try:
+            conn = ShmShardConnection(
+                addrs[0][0], addrs[0][1],
+                capacity=4096, registry=fresh_registry,
+            )
+            assert conn.proto == "shm"
+            big = self._pull(300)  # 2424-byte frame > max_record 2040
+            assert len(big) > conn._max_payload
+            small_before, oversize, small_after = conn.request_many(
+                [self._pull(8), big, self._pull(8, start=292)]
+            )
+            assert oversize.n == 300
+            rows = self._rows(oversize)
+            assert np.array_equal(rows[:8], self._rows(small_before))
+            assert np.array_equal(rows[292:], self._rows(small_after))
+            assert conn.proto == "shm" and conn.wire == "shm"
+            assert fresh_registry.counter(
+                "shmem_fallbacks_total", component="shmem",
+                reason="oversize",
+            ).value == 1
+            conn.close()
+            conn = None
+        finally:
+            if conn is not None:
+                conn.close()
+            for s in servers:
+                s.stop()
+
+    def test_batch_spill_when_responses_outgrow_ring(self):
+        """One batch whose responses total ~2x the response ring:
+        the client spills (copies borrows off the ring and releases
+        mid-batch) instead of wedging the pump until the 30s client
+        timeout — and every row still comes back correct."""
+        part, shards, servers, addrs = _mini_cluster(
+            n_shards=1, capacity=512
+        )
+        conn = ref = None
+        try:
+            conn = ShmShardConnection(
+                addrs[0][0], addrs[0][1],
+                capacity=4096, registry=False,
+            )
+            assert conn.proto == "shm"
+            # 8 pulls x 64 ids -> ~1 KiB per response, ~8.4 KiB total
+            reqs = [self._pull(64, start=64 * i) for i in range(8)]
+            t0 = time.monotonic()
+            frames = conn.request_many(reqs)
+            assert time.monotonic() - t0 < 10.0, "batch wedged"
+            assert conn.spills >= 1, "batch this size must have spilled"
+            ref = ShardConnection(
+                addrs[0][0], addrs[0][1], negotiate=True
+            )
+            for i, frame in enumerate(frames):
+                want = self._rows(ref.request_many(
+                    [self._pull(64, start=64 * i)]
+                )[0])
+                assert np.array_equal(self._rows(frame), want), f"chunk {i}"
+            # the channel survives and the next batch is zero-copy again
+            again = conn.request_many([self._pull(8)])[0]
+            assert again.n == 8
+            conn.close()
+            conn = None
+        finally:
+            if conn is not None:
+                conn.close()
+            if ref is not None:
+                ref.close()
+            for s in servers:
+                s.stop()
+
+    def test_oversize_response_is_protocol_error_not_teardown(self):
+        """A response too big for a ring record answers a clear err
+        line (the client can re-chunk) — the channel stays up; before
+        this was pinned, the pump's produce raised into its catch-all
+        and the fold looked like a dead peer."""
+        part, shards, servers, addrs = _mini_cluster(
+            n_shards=1, capacity=512
+        )
+        conn = None
+        try:
+            conn = ShmShardConnection(
+                addrs[0][0], addrs[0][1],
+                capacity=4096, registry=False,
+            )
+            assert conn.proto == "shm"
+            # request fits (1624 B) but its response (3224 B) does not
+            resp = conn.request_many([self._pull(200)])[0]
+            assert isinstance(resp, str)
+            assert resp.startswith("err bad-request")
+            assert "exceeds shm ring record limit" in resp
+            # channel still alive and serving
+            frame = conn.request_many([self._pull(8)])[0]
+            assert frame.n == 8
+            conn.close()
+            conn = None
+        finally:
+            if conn is not None:
+                conn.close()
+            for s in servers:
+                s.stop()
+
+    def test_pump_error_teardown_is_counted(self, fresh_registry):
+        """The catch-all keeps its no-raise guarantee but loses its
+        silence: an unexpected respond_frame error folds the channel
+        AND increments shmem_pump_teardowns_total{reason=error}."""
+        part, shards, servers, addrs = _mini_cluster(n_shards=1)
+        conn = None
+        try:
+            conn = ShmShardConnection(
+                addrs[0][0], addrs[0][1], registry=False,
+            )
+            assert conn.proto == "shm"
+
+            def boom(data):
+                raise RuntimeError("poisoned record")
+
+            servers[0].respond_frame = boom
+            conn._c2s.produce(K_FRAME, self._pull(8), timeout=1.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not conn._s2c.closed:
+                time.sleep(0.02)
+            assert conn._s2c.closed, "pump never folded the channel"
+            assert fresh_registry.counter(
+                "shmem_pump_teardowns_total", component="shmem",
+                reason="error",
             ).value >= 1
         finally:
             if conn is not None:
