@@ -1228,6 +1228,55 @@ def _emit_workloads_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_mesh_metric(platform: str, fallback: bool) -> None:
+    """Thirteenth (opt-in) metric line: the device-mesh store backend.
+
+    FPS_BENCH_MESH=1 runs benchmarks/mesh_backend_ab.py — PA through
+    ``store_backend="mesh"`` vs the proc-shard socket path at equal
+    worker count (updates/sec + pull/push p50/p99 + parity verdict) —
+    and writes ``results/cpu/mesh_backend_ab.{md,json}``, the artifact
+    linted by ``tools/check_metric_lines.py --mesh-ab``
+    (docs/meshstore.md).  Runs as a SUBPROCESS: the mesh arm needs
+    ``--xla_force_host_platform_device_count=8`` applied before jax's
+    backend initializes, which this process's backend is already past.
+    Default 0; failure degrades to a value-None line like every other
+    guarded line."""
+    raw = os.environ.get("FPS_BENCH_MESH", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_MESH={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "mesh backend A/B (on-device vs proc-shard sockets)"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "mesh_backend_ab.py")],
+            capture_output=True, text=True, timeout=570,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            raise RuntimeError(
+                f"no output (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-200:]}"
+            )
+        payload = json.loads(lines[-1])
+        payload["metric"] = metric
+        print(json.dumps(payload))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "x updates/sec speedup",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1261,6 +1310,7 @@ def main():
             _emit_soak_metric(platform, fallback)
             _emit_compression_metric(platform, fallback)
             _emit_workloads_metric(platform, fallback)
+            _emit_mesh_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1321,6 +1371,7 @@ def main():
     _emit_soak_metric(platform, fallback)
     _emit_compression_metric(platform, fallback)
     _emit_workloads_metric(platform, fallback)
+    _emit_mesh_metric(platform, fallback)
 
 
 if __name__ == "__main__":

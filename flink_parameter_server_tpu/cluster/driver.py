@@ -36,6 +36,12 @@ Everything is thread-backed and sleep-free on the happy path — the
 whole topology runs inside one pytest-tier process — but every byte
 still crosses a real TCP socket, so the wire protocol, coalescing and
 pipelining are exercised for real.
+
+``ClusterConfig(store_backend="mesh")`` swaps the socket topology for
+the device-mesh store (meshstore/, docs/meshstore.md): the same round
+loop, the same clock and barrier, the same workload contract — but
+pulls and pushes lower to jitted sharded gather / scatter-add over one
+global table instead of TCP frames.
 """
 from __future__ import annotations
 
@@ -60,6 +66,14 @@ class ClusterConfig:
 
     num_shards: int = 2
     num_workers: int = 1
+    # which store fronts the table (docs/meshstore.md): "socket" = N
+    # ParamShard slices behind TCP servers (every knob below applies);
+    # "mesh" = ONE mesh-sharded device array (meshstore/), pull/push
+    # lowered to jitted gather/scatter-add — the wire knobs (window,
+    # chunk, wire_format, wire_proto, spawn_grace_s, host, timeouts)
+    # are then inert, and num_shards becomes layout arithmetic (the
+    # block-aligned range partition) rather than a server count
+    store_backend: str = "socket"
     # 0 = BSP (parity with the single-process driver), k > 0 = SSP,
     # None = fully asynchronous (never block)
     staleness_bound: Optional[int] = 0
@@ -207,6 +221,45 @@ class ClusterDriver:
         self.value_shape = tuple(int(s) for s in value_shape)
         self.config = config if config is not None else ClusterConfig()
         cfg = self.config
+        if cfg.store_backend not in ("socket", "mesh"):
+            raise ValueError(
+                f"store_backend={cfg.store_backend!r}: 'socket' | 'mesh'"
+            )
+        if cfg.store_backend == "mesh":
+            # the mesh backend slots under the BASE driver's contracts
+            # only (the same discipline as shard_procs): the elastic /
+            # replication control planes re-partition and promote
+            # per-shard SERVERS, while a mesh resize is a device-count
+            # change — re-laying-out one global array, a different
+            # operation parked for the TPU window (docs/meshstore.md)
+            if type(self) is not ClusterDriver:
+                raise NotImplementedError(
+                    f"store_backend='mesh' supports the base "
+                    f"ClusterDriver only (got {type(self).__name__}: "
+                    f"elastic/replication control planes operate on "
+                    f"socket-fronted shard handles; a mesh resize is a "
+                    f"device-mesh relayout, parked for the TPU window)"
+                )
+            if cfg.shard_procs:
+                raise ValueError(
+                    "store_backend='mesh' with shard_procs=True: the "
+                    "mesh table lives in THIS process's devices — "
+                    "there is no shard process to spawn"
+                )
+            if cfg.hot_cache:
+                raise ValueError(
+                    "store_backend='mesh' with hot_cache=True: mesh "
+                    "reads are device-fresh gathers with no wire to "
+                    "save — a host-side row cache would only add a "
+                    "staleness surface"
+                )
+            if cfg.partition != "range":
+                raise ValueError(
+                    f"store_backend='mesh' requires partition='range' "
+                    f"(got {cfg.partition!r}): the mesh table is "
+                    f"row-block sharded, and only contiguous ranges "
+                    f"can align to it (docs/meshstore.md)"
+                )
         if partitioner is not None:
             self.partitioner = partitioner
         elif cfg.partition == "range":
@@ -242,6 +295,7 @@ class ClusterDriver:
             self.registry = None
         self.shards: List[ParamShard] = []
         self.servers: List[ShardServer] = []
+        self.mesh_store = None  # MeshParamStore when store_backend="mesh"
         self.clock: Optional[StalenessClock] = None
         self._clients: List[ClusterClient] = []
         self._started = False
@@ -343,10 +397,55 @@ class ClusterDriver:
         """Hook between shard spin-up and client construction (the
         elastic driver creates its membership service here)."""
 
+    def _start_mesh(self) -> None:
+        """The mesh topology: no servers to bind — align the range
+        partition to the device row-blocks, materialise the ONE global
+        table, and hand every worker a :class:`~..meshstore.MeshClient`
+        over it.  Durability (when configured) journals at
+        ``<wal_dir>/mesh``, beside where the socket topology's
+        ``shard-<i>`` directories would sit."""
+        import jax
+
+        from ..meshstore import MeshClient, MeshParamStore
+
+        cfg = self.config
+        self.partitioner = self.partitioner.block_aligned(
+            len(jax.devices())
+        )
+        self.mesh_store = MeshParamStore(
+            self.capacity,
+            self.value_shape,
+            init_fn=self._init_fn,
+            partitioner=self.partitioner,
+            wal_dir=(
+                None if cfg.wal_dir is None else f"{cfg.wal_dir}/mesh"
+            ),
+            registry=self.registry if self.registry is not None else False,
+        )
+
     def start(self) -> "ClusterDriver":
         if self._started:
             return self
         cfg = self.config
+        if cfg.store_backend == "mesh":
+            self._start_mesh()
+            self._clients = [
+                self._make_client(worker=str(w))
+                for w in range(cfg.num_workers)
+            ]
+            self.clock = StalenessClock(
+                cfg.num_workers, cfg.staleness_bound
+            )
+            if self.registry is not None:
+                self.registry.gauge(
+                    "cluster_staleness_steps", component="cluster",
+                    fn=lambda: (
+                        self.clock.staleness()
+                        if self.clock is not None else None
+                    ),
+                )
+            self._started = True
+            return self
         if cfg.trace and self.client_tracer is None:
             from ..telemetry.spans import SpanTracer
 
@@ -373,6 +472,13 @@ class ClusterDriver:
 
     def _make_client(self, worker: Optional[str] = None) -> ClusterClient:
         cfg = self.config
+        if cfg.store_backend == "mesh":
+            # the BSP / increment carve-outs below guard WIRE encodings;
+            # the mesh path has no wire — every read and write is exact
+            # fp32 on device, so both carve-outs hold vacuously
+            from ..meshstore import MeshClient
+
+            return MeshClient(self.mesh_store, worker=worker)
         # BSP carve-out (docs/compression.md): a bound-0 worker's reads
         # must see every previous-round write bitwise, so quantized
         # delta encodings downgrade to exact fp32 here — parity is
@@ -470,6 +576,9 @@ class ClusterDriver:
             shard.close()
         self.servers = []
         self.shards = []
+        if self.mesh_store is not None:
+            self.mesh_store.close()
+            self.mesh_store = None
         self._started = False
         if self._hotkey_labels:
             from ..telemetry.hotkeys import get_aggregator
@@ -688,7 +797,11 @@ class ClusterDriver:
             events=int(sum(events)),
             wall_s=wall,
             clock=clock.snapshot(),
-            shard_stats=[s.stats() for s in self.shards],
+            shard_stats=(
+                [self.mesh_store.stats()]
+                if self.mesh_store is not None
+                else [s.stats() for s in self.shards]
+            ),
         )
 
     def final_values(self) -> np.ndarray:
@@ -703,9 +816,10 @@ class ClusterDriver:
                 # so every id is read fresh from its shard (leases are
                 # re-granted in passing, which is harmless)
                 client.hotcache.clear()
-            return client.pull_batch(
+            # np.asarray: the mesh client returns the device array
+            return np.asarray(client.pull_batch(
                 np.arange(self.capacity, dtype=np.int64)
-            )
+            ))
         finally:
             if not self._clients:
                 client.close()

@@ -42,6 +42,19 @@ _GOLDEN = np.uint32(0x9E3779B1)
 _SHARD_SALT = np.uint32(0x85EBCA6B)
 
 
+def mesh_row_block(capacity: int, n_devices: int, *, window: int = 8) -> int:
+    """Rows one mesh device owns when a ``capacity``-row table is
+    row-block sharded over ``n_devices`` — the same arithmetic as
+    :meth:`~..core.store.StoreSpec.rows_per_shard` (ceil split, then
+    rounded up to the pallas 8-row ``window``).  This is the unit
+    shard boundaries must land on for a range partition to coincide
+    with the device layout (see :meth:`RangePartitioner.block_aligned`)."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices={n_devices}: must be >= 1")
+    per = -(-int(capacity) // int(n_devices))  # ceil
+    return -(-per // int(window)) * int(window)
+
+
 class Partitioner:
     """Common surface of the two maps (duck-typed; this base holds the
     local-id machinery both share)."""
@@ -146,6 +159,32 @@ class RangePartitioner(Partitioner):
             )
         return ids - lo
 
+    def block_aligned(
+        self, n_devices: int, *, window: int = 8
+    ) -> "RangePartitioner":
+        """The same map with ``rows_per_shard`` rounded UP so every
+        shard boundary is a multiple of the mesh row-block
+        (:func:`mesh_row_block`) a ``n_devices``-way device mesh gives
+        this capacity.  Until now that alignment held only by
+        convention (pick num_shards dividing the device count and hope)
+        — a misaligned table silently forces a resharding gather on
+        every pull, because a shard's rows then straddle two devices'
+        blocks.
+
+        The total padded extent ``rows_per_shard * num_shards`` stays
+        a whole number of row-blocks, so the mesh table the store
+        builds over this map needs no extra padding.  Growing the rows
+        can leave TRAILING shards short (or, for extreme
+        capacity/shard/device combinations, empty) — harmless for the
+        mesh backend, where the partitioner is layout arithmetic
+        rather than a socket address, and ``shard_of``/``owned_ids``
+        stay total and disjoint either way."""
+        block = mesh_row_block(self.capacity, n_devices, window=window)
+        aligned = RangePartitioner(self.capacity, self.num_shards)
+        aligned.rows_per_shard = -(-self.rows_per_shard // block) * block
+        aligned.aligned_block = block
+        return aligned
+
 
 class ConsistentHashPartitioner(Partitioner):
     """Rendezvous (HRW) hashing — the consistent-hash family with the
@@ -211,4 +250,9 @@ class ConsistentHashPartitioner(Partitioner):
         )
 
 
-__all__ = ["Partitioner", "RangePartitioner", "ConsistentHashPartitioner"]
+__all__ = [
+    "Partitioner",
+    "RangePartitioner",
+    "ConsistentHashPartitioner",
+    "mesh_row_block",
+]

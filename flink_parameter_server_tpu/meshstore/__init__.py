@@ -1,0 +1,35 @@
+"""meshstore/ — the device-mesh store backend (docs/meshstore.md).
+
+``ClusterConfig(store_backend="mesh")`` swaps the socket-fronted shard
+topology for ONE mesh-sharded global table: pulls are jitted sharded
+gathers, pushes are jitted masked scatter-adds with the table buffer
+donated — no socket, no frame, no host copy in the inner loop.  The
+SSP/async/BSP clock, the workload contract, WAL durability and the
+telemetry plane all keep their existing semantics; only the transport
+under ``pull_batch``/``push_batch`` changes.
+
+Develops and tier-1-tests on CPU under
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` (the conftest
+harness); on TPU the same programs route over ICI.
+"""
+from .client import MeshClient
+from .layout import (
+    SHARD_AXIS,
+    MisalignedTable,
+    aligned_partitioner,
+    check_alignment,
+    make_store_mesh,
+    table_sharding,
+)
+from .store import MeshParamStore
+
+__all__ = [
+    "SHARD_AXIS",
+    "MisalignedTable",
+    "MeshClient",
+    "MeshParamStore",
+    "aligned_partitioner",
+    "check_alignment",
+    "make_store_mesh",
+    "table_sharding",
+]

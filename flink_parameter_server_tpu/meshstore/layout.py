@@ -1,0 +1,105 @@
+"""Mesh + sharding layout for the device-mesh store backend.
+
+The whole parameter table is ONE global array laid out
+``jax.NamedSharding(mesh, P("shard"))`` over a 1-D device mesh: row
+blocks of ``mesh_row_block`` rows per device, exactly the split
+:meth:`~..core.store.StoreSpec.rows_per_shard` computes (ceil, rounded
+to the pallas 8-row window).  The helpers here pin the two layout
+contracts everything else in :mod:`..meshstore` assumes:
+
+* **one axis, one name** — ``SHARD_AXIS = "shard"``.  The table's only
+  sharded dimension is dim 0 (rows); value lanes replicate.
+* **partitioner ↔ mesh alignment** — a :class:`~..cluster.partition.
+  RangePartitioner` deployed over this table must have every shard
+  boundary on a row-block multiple (``block_aligned``), otherwise a
+  logical shard straddles two devices' blocks and XLA pays a
+  resharding gather on every pull.  :func:`check_alignment` makes the
+  convention a checked precondition.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..cluster.partition import RangePartitioner, mesh_row_block
+
+SHARD_AXIS = "shard"
+
+
+class MisalignedTable(ValueError):
+    """A partitioner whose shard boundaries do not land on mesh
+    row-block multiples — the silent-resharding hazard, made loud."""
+
+
+def make_store_mesh(devices: Optional[Sequence] = None):
+    """A 1-D device mesh over ``devices`` (default: every local jax
+    device) with the store's canonical axis name.  On the CPU test
+    harness this is the 8 virtual devices
+    ``--xla_force_host_platform_device_count=8`` forces; on TPU it is
+    the real chip mesh and the gathers ride ICI."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if not devs:
+        raise ValueError("make_store_mesh: no devices")
+    return Mesh(np.array(devs), (SHARD_AXIS,))
+
+
+def table_sharding(mesh, value_shape: Sequence[int] = ()):
+    """``NamedSharding(mesh, P("shard", None...))`` — rows split over
+    the mesh, value lanes replicated (SNIPPETS.md [2]/[3] idiom)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(
+        mesh, P(SHARD_AXIS, *([None] * len(tuple(value_shape))))
+    )
+
+
+def aligned_partitioner(
+    capacity: int, num_shards: int, n_devices: int, *, window: int = 8
+) -> RangePartitioner:
+    """A range partitioner whose shard boundaries are guaranteed mesh
+    row-block multiples for a ``n_devices``-way mesh over
+    ``capacity`` rows."""
+    return RangePartitioner(capacity, num_shards).block_aligned(
+        n_devices, window=window
+    )
+
+
+def check_alignment(
+    partitioner, capacity: int, n_devices: int, *, window: int = 8
+) -> None:
+    """Raise :class:`MisalignedTable` unless every shard boundary of
+    ``partitioner`` lands on a mesh row-block multiple.
+
+    Accepts any partitioner exposing ``rows_per_shard`` (range maps);
+    hash maps scatter ids across the whole table by construction, so
+    they can never align — reject with the remedy in the message."""
+    rows = getattr(partitioner, "rows_per_shard", None)
+    if rows is None:
+        raise MisalignedTable(
+            f"{type(partitioner).__name__} cannot align to a device "
+            f"mesh: the mesh table is row-block sharded, so the mesh "
+            f"backend requires a RangePartitioner "
+            f"(ClusterConfig.partition='range')"
+        )
+    block = mesh_row_block(capacity, n_devices, window=window)
+    if int(rows) % block != 0:
+        raise MisalignedTable(
+            f"rows_per_shard={rows} is not a multiple of the "
+            f"{block}-row mesh block ({n_devices} devices over "
+            f"{capacity} rows): every pull would pay a resharding "
+            f"gather.  Use RangePartitioner.block_aligned({n_devices})."
+        )
+
+
+__all__ = [
+    "SHARD_AXIS",
+    "MisalignedTable",
+    "make_store_mesh",
+    "table_sharding",
+    "aligned_partitioner",
+    "check_alignment",
+]

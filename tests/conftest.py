@@ -38,6 +38,26 @@ def devices():
 
 
 @pytest.fixture(scope="session")
+def mesh_devices():
+    """The ≥8 virtual devices the mesh-store tests shard over.
+
+    The XLA flag above applies only if THIS module ran before any jax
+    backend initialized; when something imported jax first (a stray
+    sitecustomize, an IDE runner collecting a single file), the flag
+    cannot retroactively split the host — so skip with the remedy
+    rather than failing on a 1-device "mesh"."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(
+            "jax initialized without --xla_force_host_platform_device_"
+            "count=8 (the flag cannot apply after backend init): run "
+            "pytest from tests/ so conftest.py sets XLA_FLAGS before "
+            "jax imports"
+        )
+    return devs
+
+
+@pytest.fixture(scope="session")
 def mesh():
     """2 workers (dp) x 4 ps shards — both reference parallelism knobs >1."""
     from flink_parameter_server_tpu.parallel.mesh import make_mesh
@@ -51,14 +71,32 @@ def rng():
 
 
 def pytest_collection_modifyitems(config, items):
-    """Skip @pytest.mark.shmem tests on hosts without usable POSIX
-    shared memory (no /dev/shm, or not writable) — the shm transport
-    itself falls back to TCP there, so there is nothing to test."""
+    """Environment-gated marker skips.
+
+    ``shmem``: hosts without usable POSIX shared memory (no /dev/shm,
+    or not writable) — the shm transport itself falls back to TCP
+    there, so there is nothing to test.
+
+    ``meshstore``: sessions where jax initialized before this conftest
+    could force 8 virtual CPU devices — the flag cannot apply
+    post-init, and a 1-device run would test nothing the marker
+    promises (deterministic ≥8-way mesh shardings)."""
     from flink_parameter_server_tpu.shmem import available
 
-    if available():
-        return
-    skip = pytest.mark.skip(reason="no writable /dev/shm on this host")
-    for item in items:
-        if "shmem" in item.keywords:
-            item.add_marker(skip)
+    if not available():
+        skip = pytest.mark.skip(reason="no writable /dev/shm on this host")
+        for item in items:
+            if "shmem" in item.keywords:
+                item.add_marker(skip)
+    if jax.device_count() < 8:
+        skip_mesh = pytest.mark.skip(
+            reason=(
+                "jax initialized without --xla_force_host_platform_"
+                "device_count=8 (the flag cannot apply after backend "
+                "init): run pytest so tests/conftest.py imports before "
+                "jax does"
+            )
+        )
+        for item in items:
+            if "meshstore" in item.keywords:
+                item.add_marker(skip_mesh)

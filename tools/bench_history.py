@@ -115,8 +115,9 @@ def load_ledger(repo: str) -> Dict[str, Dict[str, Tuple[float, str]]]:
             note(ent[0], CURRENT, ent[1], ent[2])
         # A/B artifacts carry SEVERAL metric-shaped payloads (e.g.
         # results/cpu/transport_ab.json: one per arm + the headline
-        # shares) — fold each so regressions in either arm, or in the
-        # speedup itself, flag in the worse direction
+        # shares; results/cpu/mesh_backend_ab.json: rate + pull/push
+        # p50 per backend arm) — fold each so regressions in either
+        # arm, or in the speedup itself, flag in the worse direction
         payloads = doc.get("payloads")
         if isinstance(payloads, list):
             for p in payloads:

@@ -25,13 +25,14 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Four further artifact shapes from the observability plane lint here
-too (docs/observability.md, docs/loadgen.md):
+Five further artifact shapes from the observability plane lint here
+too (docs/observability.md, docs/loadgen.md, docs/meshstore.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
     python tools/check_metric_lines.py --flightrec flightrec_stall.json
     python tools/check_metric_lines.py --budget budget.json
     python tools/check_metric_lines.py --soak soak_capacity.json
+    python tools/check_metric_lines.py --mesh-ab mesh_backend_ab.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -50,8 +51,14 @@ docs/loadgen.md): ts/run_id stamped, every arm declares
 ``latency_anchor: "arrival"`` (the coordinated-omission-free contract)
 with numeric arrival-anchored percentiles, the goodput ledger sums
 (``arrivals == ok + late + shed + error``), the capacity curve rows
-carry numeric rates, and the autoscaler score stays in [0, 1].  A mode
-flag applies to the paths that follow it.
+carry numeric rates, and the autoscaler score stays in [0, 1].
+``--mesh-ab`` checks a mesh-vs-socket backend A/B artifact
+(benchmarks/mesh_backend_ab.py, docs/meshstore.md): ts/run_id stamped,
+BOTH arms present (``mesh`` and ``socket`` — a one-armed "A/B" is the
+classic way to ship a flattering number) with numeric updates/sec and
+pull/push p50/p99, and a ``parity`` verdict field so the artifact
+records whether the two backends converged to the same model, not just
+which was faster.  A mode flag applies to the paths that follow it.
 """
 from __future__ import annotations
 
@@ -67,7 +74,7 @@ KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
      "replication", "nemesis", "hotcache", "loadgen", "compression",
-     "workloads", "shmem"}
+     "workloads", "shmem", "meshstore"}
 )
 
 
@@ -347,6 +354,65 @@ def check_soak(doc: Any) -> List[str]:
     return bad
 
 
+# the latency fields every mesh-A/B arm must report (both backends,
+# same workload, same worker count — or the comparison is theater)
+_MESH_AB_ARM_FIELDS = (
+    "updates_per_sec",
+    "pull_p50_ms", "pull_p99_ms",
+    "push_p50_ms", "push_p99_ms",
+)
+
+# what the parity field may claim; "diverged" is allowed — an honest
+# artifact that says the backends disagree still lints clean, a
+# missing/unknown verdict does not
+_MESH_AB_PARITY = frozenset({"bitwise", "allclose", "diverged"})
+
+
+def check_mesh_ab(doc: Any) -> List[str]:
+    """Lint a mesh-vs-socket backend A/B artifact
+    (benchmarks/mesh_backend_ab.py format, docs/meshstore.md)."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"mesh-ab document is {type(doc).__name__}, expected a "
+                f"JSON object"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        bad.append("missing/non-numeric 'ts'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    ab = doc.get("mesh_ab")
+    if not isinstance(ab, dict):
+        bad.append("missing/non-object 'mesh_ab'")
+        return bad
+    arms = ab.get("arms")
+    if not isinstance(arms, dict):
+        bad.append("missing/non-object 'mesh_ab.arms'")
+        return bad
+    for required in ("mesh", "socket"):
+        if required not in arms:
+            bad.append(
+                f"arm {required!r} missing — the A/B requires BOTH "
+                f"backends at equal worker count"
+            )
+    for name, arm in arms.items():
+        if not isinstance(arm, dict):
+            bad.append(f"arm {name!r}: not an object")
+            continue
+        for field in _MESH_AB_ARM_FIELDS:
+            if not isinstance(arm.get(field), (int, float)):
+                bad.append(
+                    f"arm {name!r}: missing/non-numeric {field!r}"
+                )
+    parity = ab.get("parity")
+    if parity not in _MESH_AB_PARITY:
+        bad.append(
+            f"'mesh_ab.parity' must be one of "
+            f"{sorted(_MESH_AB_PARITY)} (got {parity!r}) — the "
+            f"artifact must record whether the two backends agreed on "
+            f"the model, not just who was faster"
+        )
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -371,6 +437,8 @@ def main(argv: List[str]) -> int:
             mode = "budget"
         elif a == "--soak":
             mode = "soak"
+        elif a == "--mesh-ab":
+            mode = "mesh_ab"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -380,18 +448,19 @@ def main(argv: List[str]) -> int:
             jobs.append((mode, a))
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
-              "[--trace|--flightrec|--budget|--soak|--lines] "
+              "[--trace|--flightrec|--budget|--soak|--mesh-ab|--lines] "
               "<file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
-        if mode in ("trace", "flightrec", "budget", "soak"):
+        if mode in ("trace", "flightrec", "budget", "soak", "mesh_ab"):
             checker = {
                 "trace": check_trace_events,
                 "flightrec": check_flightrec,
                 "budget": check_budget,
                 "soak": check_soak,
+                "mesh_ab": check_mesh_ab,
             }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:

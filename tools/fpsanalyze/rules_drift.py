@@ -113,7 +113,7 @@ def default_drift_config(root: str) -> DriftConfig:
             "docs/observability.md", "docs/cluster.md",
             "docs/elastic.md", "docs/loadgen.md",
             "docs/compression.md", "docs/workloads.md",
-            "docs/shmem.md",
+            "docs/shmem.md", "docs/meshstore.md",
         ],
         known_components=KNOWN_COMPONENTS,
         metric_scan_prefixes=[pkg + "/"],
