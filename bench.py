@@ -1277,6 +1277,54 @@ def _emit_mesh_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_timeline_metric(platform: str, fallback: bool) -> None:
+    """Fourteenth (opt-in) metric line: the timeline detection A/B.
+
+    FPS_BENCH_TIMELINE=1 runs benchmarks/timeline_detection_ab.py —
+    the committed straggler-storm-SSP schedule twice (as committed +
+    fault-free oracle) with a live ``TimelineRecorder``; the metric is
+    how fast the skew tracker / detectors NAME the seeded slow shard
+    (bar: 3 sample windows, with zero oracle-arm firings) — and
+    writes ``results/cpu/soak_timeline.{md,json}``, the artifact
+    linted by ``tools/check_metric_lines.py --timeline``
+    (docs/observability.md).  Default 0; failure degrades to a
+    value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_TIMELINE", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_TIMELINE={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "timeline straggler detection latency"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "timeline_detection_ab.py")],
+            capture_output=True, text=True, timeout=570,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            raise RuntimeError(
+                f"no output (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-200:]}"
+            )
+        payload = json.loads(lines[-1])
+        payload["metric"] = metric
+        print(json.dumps(payload))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "seconds",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1311,6 +1359,7 @@ def main():
             _emit_compression_metric(platform, fallback)
             _emit_workloads_metric(platform, fallback)
             _emit_mesh_metric(platform, fallback)
+            _emit_timeline_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1372,6 +1421,7 @@ def main():
     _emit_compression_metric(platform, fallback)
     _emit_workloads_metric(platform, fallback)
     _emit_mesh_metric(platform, fallback)
+    _emit_timeline_metric(platform, fallback)
 
 
 if __name__ == "__main__":

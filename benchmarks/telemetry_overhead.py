@@ -9,7 +9,11 @@ path (telemetry/hotkeys.py), and an SLO engine sampling the registry
 on its own poll thread (telemetry/slo.py).  ISSUE 7 widened it again:
 the ON arm now ALSO runs the sampling stack profiler
 (telemetry/profiler.py ``StackSampler``, default 100 ms interval) for
-the whole measured window.  The OFF arm runs none of it.  Same logic, same store
+the whole measured window.  ISSUE 18 adds the timeline plane: a
+``TimelineRecorder`` polling every instrument into ring series at the
+same 100 ms cadence, with both online detectors (EWMA drift +
+rolling-MAD) scoring the training series on every tick.  The OFF arm
+runs none of it.  Same logic, same store
 shapes, same stream; the result folds into
 ``results/<platform>/run_report.{md,json}`` (the page
 docs/perf_status.md says future bench deltas must cite).  ``main()``
@@ -59,12 +63,19 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
         OnlineMatrixFactorization,
         SGDUpdater,
     )
+    from flink_parameter_server_tpu.telemetry.detectors import (
+        EWMADriftDetector,
+        RollingMADDetector,
+    )
     from flink_parameter_server_tpu.telemetry.hotkeys import HotKeySketch
     from flink_parameter_server_tpu.telemetry.profiler import StackSampler
     from flink_parameter_server_tpu.telemetry.slo import (
         SLOEngine,
         pull_latency_slo,
         serving_latency_slo,
+    )
+    from flink_parameter_server_tpu.telemetry.timeline import (
+        TimelineRecorder,
     )
     from flink_parameter_server_tpu.training.driver import (
         DriverConfig,
@@ -112,6 +123,25 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
         # at its default interval — its cost (tick + GIL preemption
         # tax) is paid INSIDE the measured window
         sampler = StackSampler().start()
+        # the timeline plane rides too: the recorder polls EVERY
+        # instrument at the StackSampler's cadence and both online
+        # detectors score the training series on each tick
+        timeline = TimelineRecorder(
+            interval_s=0.1,
+            detectors=[
+                EWMADriftDetector("pull_push_latency_seconds",
+                                  field="p99"),
+                RollingMADDetector("train_events_total",
+                                   field="rate"),
+            ],
+        ).start()
+        # stashed (never installed as the process default here — tests
+        # call this as a library and must not inherit a global); main()
+        # installs the final ON rep's recorder for the report section
+        global _LAST_ON_TIMELINE
+        _LAST_ON_TIMELINE = timeline
+    else:
+        timeline = None
     t0 = time.perf_counter()
     try:
         driver.run(stream)
@@ -120,8 +150,15 @@ def _one_run(*, telemetry: bool, steps: int, batch: int, num_users: int,
             slo_engine.stop()
         if sampler is not None:
             sampler.stop()
+        if timeline is not None:
+            timeline.stop()
     dt = time.perf_counter() - t0
     return driver.step_idx / dt
+
+
+# the final ON rep's (stopped) recorder — main() installs it as the
+# process default just long enough for the run report's timeline section
+_LAST_ON_TIMELINE = None
 
 
 def run_overhead_bench(
@@ -191,8 +228,8 @@ def main() -> None:
     )
     print(json.dumps({
         "metric": "telemetry overhead (registry+spans+hot-key sketch"
-                  "+SLO engine+stack sampler on vs off, CPU driver "
-                  "microbench)",
+                  "+SLO engine+stack sampler+timeline recorder on vs "
+                  "off, CPU driver microbench)",
         "value": r["overhead_pct"],
         "unit": "% slowdown (negative = within noise, faster)",
         "extra": r,
@@ -205,6 +242,9 @@ def main() -> None:
     b = run_budget_bench()
     # the A/B left the ON arm's numbers in the default registry — the
     # run report rolls them up with the overhead verdict attached
+    from flink_parameter_server_tpu.telemetry.timeline import set_timeline
+
+    set_timeline(_LAST_ON_TIMELINE)
     report = tm.build_run_report(extra={
         "telemetry_overhead_pct": r["overhead_pct"],
         "telemetry_overhead_ratio": r["overhead_ratio"],
@@ -222,6 +262,7 @@ def main() -> None:
         ),
     })
     paths = tm.write_run_report(report, platform=r["platform"])
+    set_timeline(None)
     print(f"# wrote {paths['md']} and {paths['json']}", file=sys.stderr)
 
 

@@ -119,7 +119,7 @@ import os
 import socket
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -756,6 +756,13 @@ class ClusterClient(ParameterServerClient):
             self._c_refresh = None
             self._c_storms = None
             self._c_replica_reads = self._c_fallbacks = None
+        # per-SHARD pull RTT (timeline plane, docs/observability.md):
+        # the worker-labelled histogram above answers "is this worker
+        # slow"; these lazily-registered per-shard twins answer "WHICH
+        # shard is making it slow" — the series the SkewTracker and
+        # the straggler A/B attribute against.  Lazy because the shard
+        # set is a runtime variable under the elastic plane.
+        self._h_shard_rtt: Dict[int, Any] = {}
         # latency-budget phases (telemetry/profiler.py): per-frame
         # client serialize / round trip / parse — the client side of
         # the budget.  registry=False implies profiling off too.
@@ -1483,6 +1490,23 @@ class ClusterClient(ParameterServerClient):
             out[~hot] = cold_rows
         return out
 
+    def _observe_shard_rtt(self, shard: int, per: float,
+                           frames: int) -> None:
+        """Per-shard twin of the ``cluster_pull_rtt_seconds``
+        observation: same value, extra ``shard=`` label, registered on
+        first traffic to that shard."""
+        if self._reg is None:
+            return
+        h = self._h_shard_rtt.get(shard)
+        if h is None:
+            h = self._reg.histogram(
+                "cluster_shard_rtt_seconds", component="cluster",
+                shard=str(shard), **self._labels,
+            )
+            self._h_shard_rtt[shard] = h
+        for _ in range(frames):
+            h.observe(per)
+
     def _lease_pull_shard(
         self,
         shard: int,
@@ -1557,6 +1581,7 @@ class ClusterClient(ParameterServerClient):
                 if self._h_rtt is not None:
                     self._h_rtt.observe(per)
                 prof.observe("pull", "rtt", per)
+            self._observe_shard_rtt(shard, per, len(resps))
             n_hot = len(hot_chunks)
             for i, (resp, c) in enumerate(zip(
                 resps, hot_chunks + cold_chunks
@@ -1726,6 +1751,7 @@ class ClusterClient(ParameterServerClient):
                     self._h_rtt.observe(per)
                 prof.observe("pull", "rtt", per)
                 prof.observe("pull", "client_serialize", ser_cell[0])
+            self._observe_shard_rtt(shard, per, len(resps))
             for resp, c in zip(resps, chunks):
                 if self.hotcache is not None:
                     # piggybacked inv= tokens ride any response to a

@@ -422,6 +422,14 @@ class ClusterDriver:
             ),
             registry=self.registry if self.registry is not None else False,
         )
+        if self.registry is not None:
+            # a mesh run's table lives in device memory — expose the
+            # per-device bytes_in_use/peak probes (training/tracing.py)
+            # on the same /metrics surface the meshstore_* gauges use,
+            # so an HBM blow-up is visible live, not post-OOM
+            from ..training.tracing import register_device_memory_gauges
+
+            register_device_memory_gauges(self.registry)
 
     def start(self) -> "ClusterDriver":
         if self._started:

@@ -37,6 +37,7 @@ from ..cluster.client import ClusterClient
 from ..cluster.driver import ClusterConfig, ClusterDriver
 from ..cluster.partition import ConsistentHashPartitioner
 from ..telemetry.flightrec import get_recorder
+from ..telemetry.timeline import percentile_from_counts
 from .hedging import HedgeBudget, Hedger
 from .membership import MembershipService
 from .migration import MigrationReport, execute_moves, plan_moves
@@ -373,23 +374,11 @@ class ScalePolicy:
     scale_in_consecutive: int = 1
 
 
-def _percentile_from_counts(bounds, counts, q: float) -> float:
-    """The registry histogram's interpolation, over a DELTA window's
-    bucket counts (telemetry/registry.py ``Histogram.percentile``)."""
-    total = sum(counts)
-    if total == 0:
-        return 0.0
-    rank = q / 100.0 * total
-    seen = 0.0
-    for i, c in enumerate(counts):
-        if seen + c >= rank and c > 0:
-            if i == len(bounds):
-                return bounds[-1]
-            lo = 0.0 if i == 0 else bounds[i - 1]
-            frac = (rank - seen) / c
-            return lo + (bounds[i] - lo) * min(1.0, max(0.0, frac))
-        seen += c
-    return bounds[-1]
+# the delta-window percentile math now lives with the timeline plane
+# (telemetry/timeline.py) — one implementation shared by this
+# controller's windowed RTT p99 and the TimelineRecorder's histogram
+# series; the old private name stays importable for callers/tests
+_percentile_from_counts = percentile_from_counts
 
 
 class ElasticController:
@@ -418,6 +407,7 @@ class ElasticController:
         registry=None,
         interval_s: float = 0.5,
         slo=None,
+        timeline=None,
     ):
         self.driver = driver
         self.policy = policy if policy is not None else ScalePolicy()
@@ -425,6 +415,12 @@ class ElasticController:
         # is a scale-out pressure signal alongside the raw thresholds —
         # the declarative form of the same policy
         self.slo = slo
+        # optional timeline recorder (telemetry/timeline.py): NEW
+        # detector firings since the last evaluation are scale/replace
+        # pressure alongside SLO breaches — the straggler/anomaly
+        # plane feeding the same decision the thresholds feed
+        self.timeline = timeline
+        self._anomaly_cursor = 0
         self.registry = (
             registry if registry is not None else driver.registry
         )
@@ -506,6 +502,14 @@ class ElasticController:
         if self.slo is not None:
             self.slo.sample()
             slo_breaches = self.slo.breached()
+        anomalies: List[str] = []
+        if self.timeline is not None:
+            ledger = self.timeline.anomalies()
+            anomalies = [
+                f"{a['metric']}/{a['kind']}"
+                for a in ledger[self._anomaly_cursor:]
+            ]
+            self._anomaly_cursor = len(ledger)
         decision: Optional[dict] = None
         pressured = (
             (
@@ -520,6 +524,7 @@ class ElasticController:
                 and staleness > pol.scale_out_staleness
             )
             or bool(slo_breaches)
+            or bool(anomalies)
         )
         idle = (
             p99 is not None
@@ -534,6 +539,7 @@ class ElasticController:
                     "action": "scale_out", "p99_s": p99, "depth": depth,
                     "staleness": staleness, "frames": frames,
                     "slo_breaches": slo_breaches,
+                    "timeline_anomalies": anomalies,
                 }
         elif idle:
             # hysteresis: one idle window is a data point, not a

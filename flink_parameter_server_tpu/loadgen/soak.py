@@ -263,6 +263,10 @@ class SoakReport:
     overload: Dict[str, object]
     controller_events: List[dict]
     wall_s: float
+    # the metric-series window from an attached TimelineRecorder
+    # (telemetry/timeline.py) — distinct from `timeline`, which is the
+    # goodput ledger's per-second offered/completed buckets
+    metric_timeline: Optional[dict] = None
 
     @property
     def ok(self) -> bool:
@@ -279,6 +283,7 @@ class SoakReport:
             "controller_events": self.controller_events,
             "wall_s": round(self.wall_s, 3),
             "ok": self.ok,
+            "metric_timeline": self.metric_timeline,
         }
 
 
@@ -314,7 +319,8 @@ class SoakRunner:
     """Build the stack from a :class:`SoakConfig`, run the open-loop
     soak, tear down, audit.  One-shot: construct → :meth:`run`."""
 
-    def __init__(self, config: SoakConfig, *, registry=None):
+    def __init__(self, config: SoakConfig, *, registry=None,
+                 timeline=None):
         self.config = config
         from ..telemetry.registry import MetricsRegistry
         from ..workloads import WorkloadParams, create_workload
@@ -322,6 +328,12 @@ class SoakRunner:
         self.registry = (
             registry if registry is not None else MetricsRegistry()
         )
+        # optional TimelineRecorder (telemetry/timeline.py) sampling
+        # this runner's registry for the duration of the soak; its
+        # window lands on the report as `metric_timeline` and its
+        # detector firings pressure the elastic controller when one
+        # is configured
+        self.timeline = timeline
         # num_users=64 keeps the MF logic identical to the pre-registry
         # soak (worker state is never trained here — driver.run() is
         # not called — but the table shape and init must not move under
@@ -487,7 +499,11 @@ class SoakRunner:
                 driver, policy=cfg.controller_policy,
                 registry=self.registry,
                 interval_s=cfg.controller_interval_s,
+                timeline=self.timeline,
             )
+        if self.timeline is not None:
+            self.timeline.mark("soak_start", scenario="soak")
+            self.timeline.start()
         serve_clients: List = []
         caches: List = []
         train_clients: List = []
@@ -825,6 +841,10 @@ class SoakRunner:
             stop.set()
             if controller is not None:
                 controller.stop()
+            if self.timeline is not None:
+                self.timeline.sample()   # final tick: post-run state
+                self.timeline.stop()
+                self.timeline.mark("soak_end", scenario="soak")
             for proxy in driver.mesh.values():
                 proxy.heal()
                 proxy.clear_delay()
@@ -922,12 +942,17 @@ class SoakRunner:
                 list(controller.events) if controller is not None else []
             ),
             wall_s=time.perf_counter() - t_wall0,
+            metric_timeline=(
+                self.timeline.payload() if self.timeline is not None
+                else None
+            ),
         )
 
 
-def run_soak(config: SoakConfig, *, registry=None) -> SoakReport:
+def run_soak(config: SoakConfig, *, registry=None,
+             timeline=None) -> SoakReport:
     """One-call form of :class:`SoakRunner`."""
-    return SoakRunner(config, registry=registry).run()
+    return SoakRunner(config, registry=registry, timeline=timeline).run()
 
 
 def closed_loop_capacity(

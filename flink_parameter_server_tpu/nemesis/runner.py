@@ -326,6 +326,7 @@ def run_scenario(
     witness: bool = False,
     artifact_dir: Optional[str] = None,
     serving_budget: int = 0,
+    timeline=None,
 ) -> ScenarioReport:
     """Execute one scenario end to end and check every invariant.
 
@@ -334,7 +335,13 @@ def run_scenario(
     ``witness=True`` wraps the whole topology in the lockwitness
     capture (measurably slower; the battery runs one witnessed
     scenario, not all).  ``artifact_dir`` enables failure artifacts:
-    the flight-recorder blackbox and the canonical schedule JSON."""
+    the flight-recorder blackbox and the canonical schedule JSON.
+    ``timeline`` is an optional (not-yet-started)
+    :class:`~..telemetry.timeline.TimelineRecorder` built over the
+    SAME registry: it samples for the duration of the run and every
+    executed nemesis op is ``mark()``-ed onto its time axis, so
+    detector firings can be cross-referenced against fault onset (the
+    detection A/B in benchmarks/timeline_detection_ab.py)."""
     reg = registry if registry is not None else MetricsRegistry()
     t0 = time.perf_counter()
     workload = _make_workload(scenario)
@@ -352,6 +359,11 @@ def run_scenario(
         )
         rec.note("scenario_start", name=scenario.name, seed=scenario.seed)
     flightrec.set_recorder(rec)
+    if timeline is not None:
+        timeline.mark(
+            "scenario_start", name=scenario.name, seed=scenario.seed
+        )
+        timeline.start()
 
     errors: List[str] = []
     served = [0]
@@ -401,6 +413,11 @@ def run_scenario(
                             continue
                     if rec is not None:
                         rec.note(
+                            "nemesis_op", action=op.action,
+                            shard=op.shard, at_round=op.at_round,
+                        )
+                    if timeline is not None:
+                        timeline.mark(
                             "nemesis_op", action=op.action,
                             shard=op.shard, at_round=op.at_round,
                         )
@@ -508,6 +525,10 @@ def run_scenario(
         if witness:
             inversions = list(w.inversions)
     finally:
+        if timeline is not None:
+            timeline.sample()  # one final tick: the post-run state
+            timeline.stop()
+            timeline.mark("scenario_end", name=scenario.name)
         flightrec.set_recorder(prev_rec)
 
     verdicts = [

@@ -24,6 +24,14 @@ through, and the seam every later perf PR is judged through:
     or stale-epoch storm.
   * :mod:`.slo` — declarative objectives evaluated as multi-window
     burn rates, consumable by the elastic controller.
+  * :mod:`.timeline` — the time axis: a background sampler polling
+    the registry into bounded per-instrument ring series (counters as
+    rates, gauges as values, histograms as windowed p50/p99), plus
+    the :class:`SkewTracker` per-entity straggler attribution.
+  * :mod:`.detectors` — online anomaly detectors (EWMA drift +
+    rolling-MAD outlier) riding the timeline sample loop; firings
+    count, note the flight recorder, and pressure the elastic
+    controller.
   * :mod:`.profiler` — the latency-budget profiler: per-phase cost
     attribution of every cluster round (client serialize → wire →
     queue wait → WAL → scatter → serialize → parse), plus a sampling
@@ -66,6 +74,14 @@ from .registry import (
 )
 from .report import build_run_report, render_markdown, write_run_report
 from .spans import SpanTracer, get_tracer, set_tracer, span
+from .detectors import EWMADriftDetector, RollingMADDetector
+from .timeline import (
+    SkewTracker,
+    TimelineRecorder,
+    get_timeline,
+    percentile_from_counts,
+    set_timeline,
+)
 
 __all__ = [
     "Counter",
@@ -109,4 +125,11 @@ __all__ = [
     "StackSampler",
     "get_profiler",
     "set_profiler",
+    "TimelineRecorder",
+    "SkewTracker",
+    "percentile_from_counts",
+    "get_timeline",
+    "set_timeline",
+    "EWMADriftDetector",
+    "RollingMADDetector",
 ]

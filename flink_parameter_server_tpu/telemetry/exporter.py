@@ -234,6 +234,24 @@ class TelemetryServer(LineServer):
             body = json.dumps({"conns": self.conn_table()}) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("timeline"):
+            # the timeline recorder's series window (telemetry/
+            # timeline.py): rates/values/windowed-percentiles per
+            # instrument plus marks, anomalies and skew verdicts —
+            # `psctl watch`/`psctl timeline` read this.  No recorder
+            # installed answers null (the opt-in contract; same shape
+            # as the flight recorder's)
+            from .timeline import get_timeline
+
+            tl = get_timeline()
+            body = json.dumps(
+                {"timeline": (
+                    tl.payload() if tl is not None else None
+                ),
+                 "run_id": self.registry.run_id}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         elif path.startswith("workloads"):
             # the live per-workload rate table (workloads/runtime.py):
             # cumulative update/prediction/query counters + query
@@ -251,7 +269,7 @@ class TelemetryServer(LineServer):
             body = (
                 f"unknown path {path!r} "
                 f"(metrics|healthz|hotkeys|hot|budget|conns|"
-                f"workloads)\n"
+                f"timeline|workloads)\n"
             )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"

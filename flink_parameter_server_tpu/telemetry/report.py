@@ -176,6 +176,12 @@ def build_run_report(
     hotcache = _hotcache_section()
     if hotcache is not None:
         report["hotcache"] = hotcache
+    meshstore = _meshstore_section(snap, reg)
+    if meshstore is not None:
+        report["meshstore"] = meshstore
+    timeline = _timeline_section()
+    if timeline is not None:
+        report["timeline"] = timeline
     if extra:
         report["extra"] = dict(extra)
     return report
@@ -265,6 +271,75 @@ def _hotcache_section() -> Optional[Dict[str, Any]]:
         "hit_rate": (
             round(hits / (hits + misses), 4) if hits + misses else None
         ),
+    }
+
+
+def _meshstore_section(
+    snap: Dict[str, Any], reg: MetricsRegistry
+) -> Optional[Dict[str, Any]]:
+    """On-device mesh store roll-up (meshstore/, docs/meshstore.md):
+    pull/push volume, gather/scatter collective latency, the per-kind
+    collective-op ledger and the resident byte gauges.  None when the
+    mesh backend never registered (the usual socket-shard run)."""
+    pulls = _sum_counter(snap, "meshstore_pulls_total")
+    pushes = _sum_counter(snap, "meshstore_pushes_total")
+    if not snap.get("meshstore_pulls_total") and not snap.get(
+        "meshstore_table_bytes"
+    ):
+        return None
+    collective_ops = {}
+    for s in snap.get("meshstore_collective_ops_total", ()):
+        kind = (s.get("labels") or {}).get("kind", "?")
+        collective_ops[kind] = int(
+            collective_ops.get(kind, 0) + (s["value"] or 0)
+        )
+    return {
+        "pulls": int(pulls),
+        "pushes": int(pushes),
+        "rows_pulled": int(
+            _sum_counter(snap, "meshstore_rows_pulled_total")
+        ),
+        "rows_pushed": int(
+            _sum_counter(snap, "meshstore_rows_pushed_total")
+        ),
+        "wal_appends": int(
+            _sum_counter(snap, "meshstore_wal_appends_total")
+        ),
+        "collective_ops": collective_ops,
+        "gather": _hist_percentiles(reg, "meshstore_gather_seconds"),
+        "scatter": _hist_percentiles(reg, "meshstore_scatter_seconds"),
+        "table_bytes": _find(
+            snap, "meshstore_table_bytes", component="meshstore"
+        ),
+        "device_bytes": _find(
+            snap, "meshstore_device_bytes", component="meshstore"
+        ),
+        "opt_state_bytes": _find(
+            snap, "meshstore_opt_state_bytes", component="meshstore"
+        ),
+    }
+
+
+def _timeline_section(max_rows: int = 40) -> Optional[Dict[str, Any]]:
+    """Timeline roll-up (telemetry/timeline.py): per-series
+    min/max/last plus the anomaly-episode ledger from the process
+    recorder — None when no recorder is installed (the opt-in
+    contract, same as the flight recorder's)."""
+    from .timeline import get_timeline
+
+    tl = get_timeline()
+    if tl is None:
+        return None
+    rows = tl.summary()
+    anomalies = tl.anomalies()
+    return {
+        "interval_s": tl.interval_s,
+        "samples": tl._samples,
+        "series": len(rows),
+        "rows": rows[:max_rows],
+        "rows_truncated": max(0, len(rows) - max_rows),
+        "anomalies": anomalies,
+        "skew": [t.snapshot() for t in tl.skew],
     }
 
 
@@ -414,6 +489,72 @@ def render_markdown(report: Dict[str, Any]) -> str:
                 f"{c['revocations']} | {c['stale_rejects']} | "
                 f"{c['max_served_age']} / {c['bound']} |"
             )
+    mesh = report.get("meshstore")
+    if mesh:
+        g, sc = mesh["gather"], mesh["scatter"]
+        ops = mesh.get("collective_ops", {})
+        lines += ["", "## Mesh store", ""]
+        lines += [
+            "| metric | value |",
+            "|---|---|",
+            f"| pulls / pushes | {mesh['pulls']} / {mesh['pushes']} |",
+            f"| rows pulled / pushed | {mesh['rows_pulled']} / "
+            f"{mesh['rows_pushed']} |",
+            f"| WAL appends | {mesh['wal_appends']} |",
+            f"| collective ops (gather / scatter) | "
+            f"{ops.get('gather', 0)} / {ops.get('scatter', 0)} |",
+            f"| gather p50 / p99 | {fmt(g['p50_ms'], ' ms')} / "
+            f"{fmt(g['p99_ms'], ' ms')} |",
+            f"| scatter p50 / p99 | {fmt(sc['p50_ms'], ' ms')} / "
+            f"{fmt(sc['p99_ms'], ' ms')} |",
+            f"| table / per-device / opt-state bytes | "
+            f"{fmt(mesh['table_bytes'])} / {fmt(mesh['device_bytes'])} "
+            f"/ {fmt(mesh['opt_state_bytes'])} |",
+        ]
+    tl = report.get("timeline")
+    if tl:
+        lines += ["", "## Timeline", ""]
+        lines.append(
+            f"{tl['series']} series × {tl['samples']} samples at "
+            f"{tl['interval_s']} s cadence; "
+            f"{len(tl['anomalies'])} anomaly episode(s)"
+        )
+        lines.append("")
+        lines += ["| series | labels | field | min | max | last |",
+                  "|---|---|---|---|---|---|"]
+        for row in tl["rows"]:
+            labels = ",".join(
+                f"{k}={v}" for k, v in sorted(row["labels"].items())
+                if k != "component"
+            ) or "—"
+            lines.append(
+                f"| {row['metric']} | {labels} | {row['field']} | "
+                f"{row['min']:.4g} | {row['max']:.4g} | "
+                f"{row['last']:.4g} |"
+            )
+        if tl.get("rows_truncated"):
+            lines.append(
+                f"| … {tl['rows_truncated']} more series | | | | | |"
+            )
+        if tl["anomalies"]:
+            lines.append("")
+            lines += ["| anomaly ts | metric | kind | score |",
+                      "|---|---|---|---|"]
+            for a in tl["anomalies"][:20]:
+                lines.append(
+                    f"| {a['ts']} | {a['metric']} | {a['kind']} | "
+                    f"{a['score']} |"
+                )
+        for sk in tl.get("skew", ()):
+            last = sk.get("last")
+            if last:
+                lines.append("")
+                lines.append(
+                    f"skew[{sk['metric']} by {sk['entity_label']}]: "
+                    f"top entity `{last['entity']}` at "
+                    f"{last['ratio']}× fleet median"
+                    f"{' **FLAGGED**' if last['flagged'] else ''}"
+                )
     extra = report.get("extra")
     if extra:
         lines += ["", "## Extra", ""]

@@ -25,7 +25,7 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Five further artifact shapes from the observability plane lint here
+Six further artifact shapes from the observability plane lint here
 too (docs/observability.md, docs/loadgen.md, docs/meshstore.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
@@ -33,6 +33,7 @@ too (docs/observability.md, docs/loadgen.md, docs/meshstore.md):
     python tools/check_metric_lines.py --budget budget.json
     python tools/check_metric_lines.py --soak soak_capacity.json
     python tools/check_metric_lines.py --mesh-ab mesh_backend_ab.json
+    python tools/check_metric_lines.py --timeline soak_timeline.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -58,7 +59,14 @@ BOTH arms present (``mesh`` and ``socket`` — a one-armed "A/B" is the
 classic way to ship a flattering number) with numeric updates/sec and
 pull/push p50/p99, and a ``parity`` verdict field so the artifact
 records whether the two backends converged to the same model, not just
-which was faster.  A mode flag applies to the paths that follow it.
+which was faster.  ``--timeline`` checks a metric-timeline artifact
+(telemetry/timeline.py ``TimelineRecorder.payload()``, possibly nested
+under ``arms``/``timelines``): every series' timestamps are monotone
+non-decreasing, the sampling cadence holds (median inter-point gap
+within 3x the declared ``interval_s`` — a jittering sampler quietly
+voids rate math), and every anomaly record cross-references a metric
+the artifact actually carries a series for.  A mode flag applies to
+the paths that follow it.
 """
 from __future__ import annotations
 
@@ -74,7 +82,7 @@ KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
      "replication", "nemesis", "hotcache", "loadgen", "compression",
-     "workloads", "shmem", "meshstore"}
+     "workloads", "shmem", "meshstore", "timeline"}
 )
 
 
@@ -413,6 +421,117 @@ def check_mesh_ab(doc: Any) -> List[str]:
     return bad
 
 
+def _find_timeline_payloads(doc: Any) -> List[Tuple[str, dict]]:
+    """Locate TimelineRecorder payloads in a document: the document
+    itself when it carries a ``series`` list, else any value of an
+    ``arms``/``timelines``/``timeline`` mapping that does."""
+    found: List[Tuple[str, dict]] = []
+    if not isinstance(doc, dict):
+        return found
+    if isinstance(doc.get("series"), list):
+        return [("<root>", doc)]
+    for key in ("timeline", "metric_timeline"):
+        sub = doc.get(key)
+        if isinstance(sub, dict) and isinstance(sub.get("series"), list):
+            found.append((key, sub))
+    for key in ("arms", "timelines"):
+        group = doc.get(key)
+        if isinstance(group, dict):
+            for name, sub in group.items():
+                found.extend(
+                    (f"{key}.{name}{'' if w == '<root>' else '.' + w}", p)
+                    for w, p in _find_timeline_payloads(sub)
+                )
+    return found
+
+
+def _check_one_timeline(where: str, tl: dict) -> List[str]:
+    bad: List[str] = []
+    interval = tl.get("interval_s")
+    if not isinstance(interval, (int, float)) or interval <= 0:
+        bad.append(f"{where}: missing/non-positive 'interval_s'")
+        interval = None
+    metrics_present = set()
+    for i, series in enumerate(tl.get("series", [])):
+        if not isinstance(series, dict):
+            bad.append(f"{where}: series[{i}] is not an object")
+            continue
+        metric = series.get("metric")
+        if isinstance(metric, str):
+            metrics_present.add(metric)
+        label = f"{where}: series[{i}] ({metric!r})"
+        points = series.get("points")
+        if not isinstance(points, list):
+            bad.append(f"{label}: missing/non-list 'points'")
+            continue
+        ts_prev = None
+        gaps: List[float] = []
+        for j, pt in enumerate(points):
+            if (not isinstance(pt, (list, tuple)) or len(pt) != 2
+                    or not isinstance(pt[0], (int, float))
+                    or not isinstance(pt[1], (int, float))):
+                bad.append(f"{label}: points[{j}] is not a numeric "
+                           f"[ts, value] pair")
+                continue
+            ts = float(pt[0])
+            if ts_prev is not None:
+                if ts < ts_prev:
+                    bad.append(
+                        f"{label}: timestamps regress at points[{j}] "
+                        f"({ts} < {ts_prev})"
+                    )
+                gaps.append(ts - ts_prev)
+            ts_prev = ts
+        # cadence: the MEDIAN gap must honour the declared interval —
+        # tolerant of a few legitimate long gaps (process pauses, gauge
+        # probes returning None) but not of a sampler that drifted
+        if interval is not None and len(gaps) >= 3:
+            gaps.sort()
+            median_gap = gaps[len(gaps) // 2]
+            if median_gap > 3.0 * interval:
+                bad.append(
+                    f"{label}: cadence jitter — median inter-point gap "
+                    f"{median_gap:.4f}s exceeds 3x interval_s "
+                    f"({interval}s)"
+                )
+    for i, rec in enumerate(tl.get("anomalies", [])):
+        if not isinstance(rec, dict):
+            bad.append(f"{where}: anomalies[{i}] is not an object")
+            continue
+        if not isinstance(rec.get("ts"), (int, float)):
+            bad.append(f"{where}: anomalies[{i}] missing numeric 'ts'")
+        metric = rec.get("metric")
+        if metric not in metrics_present:
+            bad.append(
+                f"{where}: anomalies[{i}] references metric {metric!r} "
+                f"but the artifact carries no series for it — an "
+                f"anomaly without its evidence is unfalsifiable"
+            )
+    for i, mark in enumerate(tl.get("marks", [])):
+        if not isinstance(mark, dict) or not isinstance(
+            mark.get("ts"), (int, float)
+        ):
+            bad.append(f"{where}: marks[{i}] missing numeric 'ts'")
+    return bad
+
+
+def check_timeline(doc: Any) -> List[str]:
+    """Lint a metric-timeline artifact (telemetry/timeline.py
+    ``TimelineRecorder.payload()`` shape, docs/observability.md) —
+    standalone or embedded under ``arms``/``timelines``."""
+    if not isinstance(doc, dict):
+        return [f"timeline document is {type(doc).__name__}, expected "
+                f"a JSON object"]
+    payloads = _find_timeline_payloads(doc)
+    if not payloads:
+        return ["no timeline payload found (need a 'series' list at "
+                "the root or under 'arms'/'timelines')"]
+    bad: List[str] = []
+    for where, tl in payloads:
+        bad.extend(_check_one_timeline(where, tl))
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -439,6 +558,8 @@ def main(argv: List[str]) -> int:
             mode = "soak"
         elif a == "--mesh-ab":
             mode = "mesh_ab"
+        elif a == "--timeline":
+            mode = "timeline"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -448,19 +569,21 @@ def main(argv: List[str]) -> int:
             jobs.append((mode, a))
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
-              "[--trace|--flightrec|--budget|--soak|--mesh-ab|--lines] "
-              "<file|-> ...",
+              "[--trace|--flightrec|--budget|--soak|--mesh-ab|"
+              "--timeline|--lines] <file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
-        if mode in ("trace", "flightrec", "budget", "soak", "mesh_ab"):
+        if mode in ("trace", "flightrec", "budget", "soak", "mesh_ab",
+                    "timeline"):
             checker = {
                 "trace": check_trace_events,
                 "flightrec": check_flightrec,
                 "budget": check_budget,
                 "soak": check_soak,
                 "mesh_ab": check_mesh_ab,
+                "timeline": check_timeline,
             }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:

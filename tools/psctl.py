@@ -23,6 +23,9 @@ Usage::
                  [--json]
     psctl workloads --metrics HOST:PORT [--interval 2]
                  [--iterations 0] [--json]
+    psctl watch  --metrics HOST:PORT [--interval 2] [--iterations 0]
+                 [-n 16] [--raw]
+    psctl timeline METRIC --metrics HOST:PORT [--json]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -76,6 +79,23 @@ path's cumulative counters between scrapes, plus the serving-verb
 latency percentiles (``fps_workload_query_latency_seconds``) and
 serving errors.  The first frame shows cumulative totals (in
 parentheses) until a second scrape makes rates derivable.
+
+``watch`` is the trend view over ``top``'s numbers: every counter the
+endpoint exports (identified from the ``# TYPE`` comment lines) gets a
+per-label-set rate derived from deltas between scrapes, and the top-N
+rows by current rate render with a unicode sparkline of the rate
+history accumulated across frames — a straggling shard or a storming
+key family shows up as a diverging trend line, not just a number.
+Same ``--interval``/``--iterations``/``--raw`` loop as ``top``.
+
+``timeline`` renders one metric's recorded series window from the
+telemetry endpoint's ``timeline`` path (a process-installed
+``TimelineRecorder``, telemetry/timeline.py): one row per label-set ×
+field (rate/value/p50/p99) with point count, min/max/last, and a
+sparkline of the series tail, followed by the recorder's anomaly
+ledger entries for that metric.  Accepts the bare registry name or
+the ``fps_``-prefixed exporter name; ``--json`` emits the filtered
+payload.
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -170,6 +190,20 @@ def parse_prometheus(text: str) -> Dict[Tuple[str, tuple], float]:
         except ValueError:
             continue  # NaN markers etc. stay out of the rate math
         out[(m.group("name"), labels)] = value
+    return out
+
+
+_TYPE_RE = re.compile(r"^#\s*TYPE\s+(\S+)\s+(\S+)\s*$")
+
+
+def parse_prometheus_types(text: str) -> Dict[str, str]:
+    """``{metric_name: type}`` from the ``# TYPE name kind`` comment
+    lines (the lines :func:`parse_prometheus` skips)."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        m = _TYPE_RE.match(line.strip())
+        if m is not None:
+            out[m.group(1)] = m.group(2)
     return out
 
 
@@ -760,6 +794,156 @@ def cmd_budget(args) -> int:
     return 0
 
 
+# rate-history sparklines: eight levels, min→max over the window
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 24) -> str:
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo <= 1e-12:
+        return _SPARK[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _SPARK[int((v - lo) / span * (len(_SPARK) - 1))] for v in vals
+    )
+
+
+def _labels_cell(labels) -> str:
+    cell = ",".join(
+        f"{k}={v}" for k, v in labels if k != "component"
+    )
+    return cell or "—"
+
+
+def cmd_watch(args) -> int:
+    host, port = parse_addr(args.metrics)
+    prev: Optional[Dict[Tuple[str, tuple], float]] = None
+    prev_t = 0.0
+    history: Dict[Tuple[str, tuple], List[float]] = {}
+    shown = 0
+    while True:
+        try:
+            text = scrape(host, port, "metrics")
+        except OSError as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        samples = parse_prometheus(text)
+        types = parse_prometheus_types(text)
+        now = time.time()
+        dt = now - prev_t if prev is not None else 0.0
+        if dt > 0:
+            for key, v in samples.items():
+                if types.get(key[0]) != "counter":
+                    continue
+                pv = prev.get(key)
+                if pv is None:
+                    continue
+                hist = history.setdefault(key, [])
+                hist.append(max(0.0, (v - pv) / dt))
+                del hist[:-64]
+        ranked = sorted(
+            history.items(), key=lambda kv: kv[1][-1], reverse=True
+        )
+        rows = [
+            [name, _labels_cell(labels), f"{hist[-1]:,.1f}",
+             _sparkline(hist)]
+            for (name, labels), hist in ranked[: args.n]
+        ]
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(
+            f"psctl watch — {host}:{port} — "
+            f"{time.strftime('%H:%M:%S', time.localtime(now))} — "
+            f"counter rates/sec (top {args.n})"
+        )
+        if rows:
+            print(_render_table(
+                ["counter", "labels", "rate/s", "trend"], rows
+            ), flush=True)
+        else:
+            print("(first scrape — rates derivable from the next frame)",
+                  flush=True)
+        prev, prev_t = samples, now
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_timeline(args) -> int:
+    host, port = parse_addr(args.metrics)
+    try:
+        doc = json.loads(scrape(host, port, "timeline"))
+    except OSError as e:
+        print(f"psctl: {args.metrics} unreachable: {e}", file=sys.stderr)
+        return 1
+    tl = doc.get("timeline")
+    if tl is None:
+        print("psctl: no TimelineRecorder installed on this process "
+              "(telemetry.timeline.set_timeline)", file=sys.stderr)
+        return 1
+    want = args.metric
+    bare = want[4:] if want.startswith("fps_") else want
+    series = [
+        s for s in tl.get("series", [])
+        if s.get("metric") in (want, bare)
+    ]
+    anomalies = [
+        a for a in tl.get("anomalies", [])
+        if a.get("metric") in (want, bare)
+    ]
+    if args.json:
+        print(json.dumps(
+            {"metric": bare, "interval_s": tl.get("interval_s"),
+             "samples": tl.get("samples"), "series": series,
+             "anomalies": anomalies, "run_id": doc.get("run_id")},
+            indent=2,
+        ))
+        return 0
+    if not series:
+        known = sorted({
+            str(s.get("metric")) for s in tl.get("series", [])
+        })
+        print(f"psctl: no recorded series for {want!r}; recorder "
+              f"carries: {', '.join(known) or '(none yet)'}",
+              file=sys.stderr)
+        return 1
+    print(
+        f"psctl timeline — {bare} — {len(series)} series, "
+        f"{tl.get('samples')} samples @ {tl.get('interval_s')}s"
+    )
+    rows = []
+    for s in series:
+        vals = [
+            p[1] for p in s.get("points", [])
+            if isinstance(p, (list, tuple)) and len(p) == 2
+        ]
+        if not vals:
+            continue
+        rows.append([
+            _labels_cell(sorted((s.get("labels") or {}).items())),
+            str(s.get("field", "?")), str(len(vals)),
+            f"{min(vals):.4g}", f"{max(vals):.4g}", f"{vals[-1]:.4g}",
+            _sparkline(vals),
+        ])
+    print(_render_table(
+        ["labels", "field", "points", "min", "max", "last", "trend"],
+        rows,
+    ))
+    if anomalies:
+        print(f"\n{len(anomalies)} anomaly episode(s):")
+        for a in anomalies[-20:]:
+            print(
+                f"  ts={a.get('ts'):.3f}  {a.get('kind')}  "
+                f"labels={a.get('labels')}  score={a.get('score')}"
+            )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="psctl", description=__doc__,
@@ -842,6 +1026,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     wl.add_argument("--json", action="store_true",
                     help="emit the raw payload once")
     wl.set_defaults(fn=cmd_workloads)
+
+    wa = sub.add_parser(
+        "watch",
+        help="live counter-rate table with sparkline trends",
+    )
+    wa.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    wa.add_argument("--interval", type=float, default=2.0)
+    wa.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = forever)")
+    wa.add_argument("-n", type=int, default=16,
+                    help="rows to show (default 16)")
+    wa.add_argument("--raw", action="store_true",
+                    help="no screen clear (pipe/CI friendly)")
+    wa.set_defaults(fn=cmd_watch)
+
+    tlp = sub.add_parser(
+        "timeline",
+        help="one metric's recorded series window + anomaly ledger",
+    )
+    tlp.add_argument("metric",
+                     help="registry name (bare or fps_-prefixed)")
+    tlp.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    tlp.add_argument("--json", action="store_true",
+                     help="emit the filtered payload")
+    tlp.set_defaults(fn=cmd_timeline)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
