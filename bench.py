@@ -1325,6 +1325,54 @@ def _emit_timeline_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_straggler_metric(platform: str, fallback: bool) -> None:
+    """Fifteenth (opt-in) metric line: the straggler goodput A/B.
+
+    FPS_BENCH_STRAGGLER=1 runs benchmarks/straggler_ab.py — worker 0's
+    links through an 8 ms delay proxy, the same deadline-bounded job
+    under stock SSP vs the adaptive runtime (docs/adaptive.md), both
+    MF and PA; the metric is the worst-workload goodput ratio
+    (bar: >= 2x at equal final-table RMSE, bound envelope green) —
+    and writes ``results/cpu/straggler_ab.{md,json}``, the artifact
+    linted by ``tools/check_metric_lines.py --straggler-ab``.
+    Default 0; failure degrades to a value-None line like every other
+    guarded line."""
+    raw = os.environ.get("FPS_BENCH_STRAGGLER", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_STRAGGLER={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "straggler adaptive goodput ratio"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "straggler_ab.py")],
+            capture_output=True, text=True, timeout=570,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            raise RuntimeError(
+                f"no output (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-200:]}"
+            )
+        payload = json.loads(lines[-1])
+        payload["metric"] = metric
+        print(json.dumps(payload))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "x (adaptive / fixed-bound, worst workload)",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1360,6 +1408,7 @@ def main():
             _emit_workloads_metric(platform, fallback)
             _emit_mesh_metric(platform, fallback)
             _emit_timeline_metric(platform, fallback)
+            _emit_straggler_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1422,6 +1471,7 @@ def main():
     _emit_workloads_metric(platform, fallback)
     _emit_mesh_metric(platform, fallback)
     _emit_timeline_metric(platform, fallback)
+    _emit_straggler_metric(platform, fallback)
 
 
 if __name__ == "__main__":

@@ -556,6 +556,7 @@ class ClusterClient(ParameterServerClient):
         replicas=None,
         read_replicas: bool = True,
         hedge=None,
+        push_hedge=None,
         hotcache=None,
         lease_policy=None,
         lease_ttl: int = 16,
@@ -608,6 +609,10 @@ class ClusterClient(ParameterServerClient):
             )
         self.membership = membership
         self.hedge = hedge
+        # write-side hedging is only safe when pushes carry a pid (the
+        # (pid,id) dedupe window suppresses the losing leg's apply), so
+        # _push_shard gates on pid presence, not just this handle
+        self.push_hedge = push_hedge
         self.value_shape = tuple(int(s) for s in value_shape)
         self.chunk = int(chunk)
         # b64 (default): exact fp32 bytes, ~100x cheaper than per-float
@@ -1277,6 +1282,8 @@ class ClusterClient(ParameterServerClient):
         self._pool.close()
         if self.hedge is not None:
             self.hedge.close()
+        if self.push_hedge is not None:
+            self.push_hedge.close()
 
     # -- internals ----------------------------------------------------------
     def _split(self, unique_ids: np.ndarray) -> Dict[int, np.ndarray]:
@@ -1343,7 +1350,7 @@ class ClusterClient(ParameterServerClient):
 
     def _request_frames(
         self, shard: int, sids: np.ndarray, lines, *,
-        hedgeable: bool, trace=None,
+        hedgeable: bool, hedger=None, trace=None,
     ) -> List:
         """Send one shard's frames; a connection-level failure in
         elastic mode becomes a :class:`_Rejected` (drop the cached
@@ -1358,7 +1365,8 @@ class ClusterClient(ParameterServerClient):
         try:
             conn = self._conn_for(shard)
             reqs = self._materialize(lines, conn)
-            if hedgeable and self.hedge is not None:
+            h = hedger if hedger is not None else self.hedge
+            if hedgeable and h is not None:
                 addr = self._addresses[shard]
 
                 def on_backup_won(spare_conn):
@@ -1370,7 +1378,7 @@ class ClusterClient(ParameterServerClient):
                         old.close()
                     self._conns[addr] = spare_conn
 
-                resps = self.hedge.request_many(
+                resps = h.request_many(
                     conn,
                     lambda: self._dial(addr),
                     reqs,
@@ -1885,8 +1893,14 @@ class ClusterClient(ParameterServerClient):
         # trip, the same window the push phases decompose
         with span_cm:
             t0 = time.perf_counter()
+            # hedged only when the batch carries a pid: the shard's
+            # (pid,id) dedupe window then absorbs the losing leg's
+            # duplicate apply, the same way it absorbs ambiguous
+            # retries — without a pid a raced push would double-apply
             resps = self._request_frames(
-                shard, ids, build, hedgeable=False
+                shard, ids, build,
+                hedgeable=(pid is not None and self.push_hedge is not None),
+                hedger=self.push_hedge,
             )
             per = (
                 (time.perf_counter() - t0) / max(1, len(resps))

@@ -71,16 +71,19 @@ class StalenessClock:
             blocked = False
 
             def clear() -> bool:
-                return (
-                    self._clocks[worker] - self._min_active_locked()
-                    <= self.bound
-                )
+                return self._clear_locked(worker)
 
             if not clear():
                 blocked = True
                 self.block_counts[worker] += 1
             ok = self._cond.wait_for(clear, timeout=timeout)
             return ok or not blocked
+
+    def _clear_locked(self, worker: int) -> bool:
+        """Gate predicate, evaluated under ``self._cond``.  Subclasses
+        (``adaptive.bounds.AdaptiveClock``) override this to apply
+        per-worker allowances instead of the single global bound."""
+        return self._clocks[worker] - self._min_active_locked() <= self.bound
 
     def tick(self, worker: int) -> int:
         """Worker completed a round (its pushes are durable at the

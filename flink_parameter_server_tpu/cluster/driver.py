@@ -173,6 +173,21 @@ class ClusterConfig:
     # measured within the ≤3% telemetry overhead bar; False switches
     # every phase timer to the shared no-op.
     profile: bool = True
+    # straggler-adaptive runtime (adaptive/, docs/adaptive.md) — the
+    # kill switch.  When True the driver builds an AdaptiveClock
+    # (per-worker staleness allowances, widened for flagged stragglers
+    # up to adaptive_bound_ceiling and never below staleness_bound)
+    # and honors self.work_router in _worker_mask; elastic drivers
+    # additionally attach a PushHedger to worker clients when
+    # adaptive_push_hedge_after_s is set.  False = stock StalenessClock
+    # and identity routing — byte-for-byte the non-adaptive driver.
+    adaptive: bool = False
+    # hard cap on any worker's widened allowance; None = 2*bound + 1
+    # (one full extra SSP window), see adaptive/bounds.py
+    adaptive_bound_ceiling: Optional[int] = None
+    # push-hedge deferral (seconds); None = push hedging off.  Only
+    # effective on membership-backed clients (pid-carrying pushes).
+    adaptive_push_hedge_after_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -297,6 +312,10 @@ class ClusterDriver:
         self.servers: List[ShardServer] = []
         self.mesh_store = None  # MeshParamStore when store_backend="mesh"
         self.clock: Optional[StalenessClock] = None
+        # adaptive work re-routing (adaptive/rebalance.py): when set
+        # (and cfg.adaptive), _worker_mask consults it instead of the
+        # static hash route; None = identity (stock routing)
+        self.work_router = None
         self._clients: List[ClusterClient] = []
         self._started = False
         self._step_fn = None
@@ -397,6 +416,23 @@ class ClusterDriver:
         """Hook between shard spin-up and client construction (the
         elastic driver creates its membership service here)."""
 
+    def _make_clock(self) -> StalenessClock:
+        """One construction point for the SSP clock so the adaptive
+        kill switch swaps in per-worker allowances everywhere (start()
+        both topologies + the fresh-clock-per-run() site)."""
+        cfg = self.config
+        if getattr(cfg, "adaptive", False):
+            from ..adaptive.bounds import AdaptiveClock
+
+            bound = cfg.staleness_bound
+            ceiling = getattr(cfg, "adaptive_bound_ceiling", None)
+            if ceiling is None and bound is not None:
+                ceiling = 2 * bound + 1
+            return AdaptiveClock(
+                cfg.num_workers, bound, bound_ceiling=ceiling
+            )
+        return StalenessClock(cfg.num_workers, cfg.staleness_bound)
+
     def _start_mesh(self) -> None:
         """The mesh topology: no servers to bind — align the range
         partition to the device row-blocks, materialise the ONE global
@@ -441,9 +477,7 @@ class ClusterDriver:
                 self._make_client(worker=str(w))
                 for w in range(cfg.num_workers)
             ]
-            self.clock = StalenessClock(
-                cfg.num_workers, cfg.staleness_bound
-            )
+            self.clock = self._make_clock()
             if self.registry is not None:
                 self.registry.gauge(
                     "cluster_staleness_steps", component="cluster",
@@ -467,7 +501,7 @@ class ClusterDriver:
             self._make_client(worker=str(w))
             for w in range(cfg.num_workers)
         ]
-        self.clock = StalenessClock(cfg.num_workers, cfg.staleness_bound)
+        self.clock = self._make_clock()
         if self.registry is not None:
             self.registry.gauge(
                 "cluster_staleness_steps", component="cluster",
@@ -609,7 +643,9 @@ class ClusterDriver:
         self.stop()
 
     # -- the job ------------------------------------------------------------
-    def _worker_mask(self, batch: dict, worker: int) -> np.ndarray:
+    def _worker_mask(
+        self, batch: dict, worker: int, round_idx: int = 0
+    ) -> np.ndarray:
         cfg = self.config
         base = np.asarray(
             batch.get("mask", np.ones(self._batch_len(batch), bool))
@@ -623,6 +659,13 @@ class ClusterDriver:
                 f"ClusterConfig.worker_key)"
             )
         keys = np.asarray(batch[cfg.worker_key], np.int64)
+        router = self.work_router
+        if router is not None and getattr(cfg, "adaptive", False):
+            # adaptive re-routing (adaptive/rebalance.py): ownership is
+            # a pure function of (key, round) and every worker asks
+            # about the same round, so exactly-once per row per round
+            # is preserved even while groups migrate
+            return base & router.owner_mask(keys, worker, round_idx)
         owner = fmix32_np(keys) % np.uint32(cfg.num_workers)
         return base & (owner == np.uint32(worker))
 
@@ -637,12 +680,18 @@ class ClusterDriver:
         collect_outputs: bool = False,
         round_hook: Optional[Callable[[int, int], None]] = None,
         timeout: float = 300.0,
+        deadline_s: Optional[float] = None,
     ) -> ClusterResult:
         """Train over ``batches`` (a finite iterable of microbatch
         dicts); every worker walks the full sequence with its ownership
         mask applied.  ``round_hook(worker, round)`` fires at each round
         start on the worker's thread — the straggler-injection point
-        the SSP tests use.  Returns the assembled final table."""
+        the SSP tests use.  ``deadline_s`` turns the run time-bounded:
+        each worker stops at the first round boundary past the
+        deadline (goodput benchmarking — under a fixed wall budget the
+        work completed IS the metric, whereas on a fixed workload the
+        wall clock is floored by the straggler in every arm).  Returns
+        the assembled final table."""
         import jax
 
         if not self._started:
@@ -657,9 +706,7 @@ class ClusterDriver:
         # fresh clock per run: the previous run's workers deactivated
         # themselves at stream end (frozen counters must not gate a new
         # job); the staleness gauge reads self.clock so it follows
-        clock = self.clock = StalenessClock(
-            cfg.num_workers, cfg.staleness_bound
-        )
+        clock = self.clock = self._make_clock()
         # bound-0 intra-round barrier: reads of round t must not see
         # round-t writes (see module docstring)
         pull_barrier = (
@@ -671,6 +718,23 @@ class ClusterDriver:
         # uplink per run, workers rendezvous per round and the shards
         # see ONE merged push — fresh per run (a broken barrier must
         # not leak into the next job)
+        if deadline_s is not None and cfg.push_aggregate:
+            raise ValueError(
+                "deadline_s is incompatible with push_aggregate: a "
+                "deadline-stopped worker would strand its siblings at "
+                "the push rendezvous"
+            )
+        deadline_t = (
+            time.perf_counter() + float(deadline_s)
+            if deadline_s is not None else None
+        )
+
+        def past_deadline() -> bool:
+            return (
+                deadline_t is not None
+                and time.perf_counter() >= deadline_t
+            )
+
         push_agg = None
         if cfg.push_aggregate and cfg.num_workers > 1:
             from ..compression.aggregator import PushAggregator
@@ -714,6 +778,13 @@ class ClusterDriver:
                 for t, batch in enumerate(batches):
                     if errors:
                         break
+                    if past_deadline():
+                        # round-boundary stop: this worker's completed
+                        # rounds stay counted, the aborted barrier
+                        # releases any bound-0 sibling mid-round
+                        if pull_barrier is not None:
+                            pull_barrier.abort()
+                        break
                     if round_hook is not None:
                         round_hook(w, t)
                     if not clock.wait_for_turn(w, timeout=timeout):
@@ -722,7 +793,7 @@ class ClusterDriver:
                             f"(bound={cfg.staleness_bound})"
                         )
                     wb = dict(batch)
-                    wb["mask"] = self._worker_mask(batch, w)
+                    wb["mask"] = self._worker_mask(batch, w, t)
                     ids = np.asarray(self.logic.keys(wb))
                     # multi-key workloads (PA's sparse (B, K) feature
                     # ids, a sketch's (B, depth) cells) pull several
@@ -738,9 +809,26 @@ class ClusterDriver:
                             ),
                             ids.shape,
                         )
-                    pulled = client.pull_batch(ids, mask=kmask)
+                    if kmask.any():
+                        pulled = client.pull_batch(ids, mask=kmask)
+                    else:
+                        # a fully masked round owns no rows — e.g. a
+                        # drained straggler after adaptive re-routing
+                        # (adaptive/rebalance.py) — and must cost no
+                        # wire: coalesce_ids would otherwise pull one
+                        # fill id.  Masked lanes are padding by the
+                        # store contract, so zeros feed the step.
+                        pulled = np.zeros(
+                            ids.shape + tuple(self.value_shape),
+                            np.float32,
+                        )
                     if pull_barrier is not None:
-                        pull_barrier.wait(timeout=timeout)
+                        try:
+                            pull_barrier.wait(timeout=timeout)
+                        except threading.BrokenBarrierError:
+                            if past_deadline():
+                                break  # a sibling deadline-stopped
+                            raise
                     state, req, out = self._step_fn(
                         state, wb, jnp.asarray(pulled)
                     )

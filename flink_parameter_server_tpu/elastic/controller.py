@@ -130,6 +130,22 @@ class ElasticClusterDriver(ClusterDriver):
                     self.registry if self.registry is not None else False
                 ),
             )
+        push_hedge = None
+        if (getattr(cfg, "adaptive", False)
+                and getattr(cfg, "adaptive_push_hedge_after_s", None)):
+            # write-side twin of the pull hedger (adaptive/hedge.py);
+            # safe here because membership-backed clients stamp a pid
+            # on every push, so the (pid,id) dedupe window suppresses
+            # the losing leg's duplicate apply
+            from ..adaptive.hedge import PushHedger
+
+            push_hedge = PushHedger(
+                cfg.adaptive_push_hedge_after_s,
+                budget=HedgeBudget(cfg.hedge_max_fraction),
+                registry=(
+                    self.registry if self.registry is not None else False
+                ),
+            )
         client = ClusterClient(
             value_shape=self.value_shape,
             window=cfg.window,
@@ -141,6 +157,7 @@ class ElasticClusterDriver(ClusterDriver):
             worker=worker,
             membership=self.membership,
             hedge=hedge,
+            push_hedge=push_hedge,
             retry_timeout=getattr(cfg, "retry_timeout", 30.0),
             tracer=self.client_tracer,
         )
@@ -253,6 +270,43 @@ class ElasticClusterDriver(ClusterDriver):
                 shard.close()
                 self._retired.append((shard, server))
             return report
+
+    def drain_shard(
+        self, shard_id: int, *, weight: float = 0.0
+    ) -> MigrationReport:
+        """Adaptive rebalance actuator (adaptive/rebalance.py): lower
+        ``shard_id``'s rendezvous weight so its keys migrate onto the
+        healthy shards — same verified plan_moves/execute_moves data
+        plane and one-shot epoch flip as a resize, but the shard set
+        is unchanged; the drained shard keeps serving whatever keys
+        its weight still wins (none, at ``weight=0``).  Requires the
+        hash partition family (the weight rides the HRW scores)."""
+        from ..adaptive.rebalance import DrainedHashPartitioner
+
+        with self._resize_lock:
+            if not self._started:
+                raise RuntimeError("drain_shard on a stopped driver")
+            old_part = self.partitioner
+            if not hasattr(old_part, "seed"):
+                raise ValueError(
+                    "drain_shard needs the hash partition family "
+                    "(ClusterConfig.partition='hash'), got "
+                    f"{type(old_part).__name__}"
+                )
+            if not 0 <= shard_id < old_part.num_shards:
+                raise ValueError(f"no shard {shard_id}")
+            new_part = DrainedHashPartitioner.draining(
+                old_part, shard_id, weight
+            )
+            try:
+                return self._migrate_and_flip(
+                    old_part, new_part,
+                    shards=self.shards, servers=self.servers,
+                )
+            except BaseException:
+                for shard in self.shards:
+                    shard.unfreeze()
+                raise
 
     def _migrate_and_flip(
         self, old_part, new_part, *, shards, servers

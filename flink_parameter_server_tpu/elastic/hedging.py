@@ -130,14 +130,19 @@ class Hedger:
             from ..telemetry.registry import get_registry
 
             reg = registry if registry is not None else get_registry()
-            self._c_issued = reg.counter(
-                "elastic_hedged_pulls_total", component="elastic"
-            )
-            self._c_won = reg.counter(
-                "elastic_hedges_won_total", component="elastic"
-            )
+            self._register_counters(reg)
         else:
             self._c_issued = self._c_won = None
+
+    def _register_counters(self, reg) -> None:
+        """Subclasses (adaptive.hedge.PushHedger) register their own
+        literal instrument names here."""
+        self._c_issued = reg.counter(
+            "elastic_hedged_pulls_total", component="elastic"
+        )
+        self._c_won = reg.counter(
+            "elastic_hedges_won_total", component="elastic"
+        )
 
     # -- spare lifecycle ----------------------------------------------------
     def _acquire_spare(

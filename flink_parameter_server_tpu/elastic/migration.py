@@ -73,7 +73,9 @@ def plan_moves(old: Partitioner, new: Partitioner) -> List[Move]:
     over the whole parameter space); stationary keys appear in none.
     Works for growth (moves land on new shards only, the rendezvous
     invariant), shrink (retired shards drain to survivors), and any
-    same-capacity remap."""
+    same-capacity remap — including the adaptive straggler drain
+    (adaptive/rebalance.DrainedHashPartitioner), whose weighted remap
+    moves keys exclusively OFF the drained shard."""
     if old.capacity != new.capacity:
         raise ValueError(
             f"cannot migrate between maps of capacity {old.capacity} "

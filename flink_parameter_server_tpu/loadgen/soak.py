@@ -201,6 +201,12 @@ class SoakConfig:
     # (compression/aggregator.py; the exactly-once ledger balances on
     # the uplink)
     push_aggregate: bool = False
+    # straggler-adaptive runtime kill-switch (adaptive/): the soak
+    # runs a single uplink worker on an async serve clock, so the
+    # dynamic SSP bounds are inert here — but the push hedger rides
+    # the train uplink, and flipping this arms it end to end
+    adaptive: bool = False
+    adaptive_push_hedge_after_s: Optional[float] = None
     link_delay_ms: float = 1.0          # per-request mesh delay (c2s)
     # the goodput deadline: an answer later than this is badput
     slo_ms: float = 100.0
@@ -359,6 +365,8 @@ class SoakRunner:
                 wal_dir=wal_dir,
                 wire_format=cfg.wire_format,
                 replication_factor=cfg.replication_factor,
+                adaptive=cfg.adaptive,
+                adaptive_push_hedge_after_s=cfg.adaptive_push_hedge_after_s,
                 request_timeout=cfg.request_timeout,
                 connect_timeout=cfg.connect_timeout,
                 retry_timeout=cfg.retry_timeout,

@@ -53,7 +53,7 @@ import numpy as np
 _OWNED_THREAD_PREFIXES = (
     "shard-", "nemesis-", "cluster-", "elastic-", "repl-", "serving",
     "chaos", "line-server", "wal-", "hb-", "ship-", "telemetry",
-    "hotcache-", "loadgen-",
+    "hotcache-", "loadgen-", "adaptive", "timeline-",
 )
 
 
@@ -181,6 +181,44 @@ def check_staleness(
     )
 
 
+def check_adaptive_bound(
+    samples: Sequence[Sequence[int]],
+    bound: Optional[int],
+    ceiling: Optional[int],
+) -> Verdict:
+    """The adaptive-bounds safety envelope (adaptive/bounds.py): every
+    live-sampled per-worker EFFECTIVE bound stays within
+    ``[bound, ceiling]`` — widening never exceeds the declared ceiling
+    and narrowing never undercuts the correctness bound.  Vacuous
+    passes are rejected the way lease_staleness rejects them: at least
+    one sample must have been taken from a live adaptive clock,
+    otherwise the scenario never exercised the invariant it claims to
+    prove.  Async (bound None) has no allowances to audit and passes
+    on the sampler having seen the clock."""
+    n = len(samples)
+    if bound is None:
+        return Verdict(
+            "adaptive_bound_envelope", n > 0,
+            f"async clock, {n} sample(s)"
+            + ("" if n else " — never sampled (vacuous)"),
+        )
+    low = min(
+        (min(row) for row in samples if len(row)), default=bound
+    )
+    high = max(
+        (max(row) for row in samples if len(row)), default=bound
+    )
+    ok = n > 0 and low >= bound and high <= ceiling
+    return Verdict(
+        "adaptive_bound_envelope", ok,
+        f"samples={n} effective bounds in [{low}, {high}] vs "
+        f"declared [{bound}, {ceiling}]"
+        + ("" if high <= ceiling else " — CEILING VIOLATED")
+        + ("" if low >= bound else " — CORRECTNESS BOUND VIOLATED")
+        + ("" if n else " — never sampled (vacuous)"),
+    )
+
+
 def check_serving_budget(
     served: int, errors: int, *, budget: int = 0
 ) -> Verdict:
@@ -256,6 +294,45 @@ class ThreadLedger:
         )
 
 
+class AdaptiveBoundSampler:
+    """Polls the driver clock's per-worker effective bounds while a
+    scenario runs (same re-read-every-tick discipline as
+    :class:`StalenessSampler` — the driver swaps in a fresh clock at
+    run start).  Only adaptive clocks yield samples; a stock clock
+    leaves ``samples`` empty and :func:`check_adaptive_bound` then
+    rejects the run as vacuous."""
+
+    def __init__(self, driver, interval_s: float = 0.002):
+        self._driver = driver
+        self._interval = float(interval_s)
+        self.samples: List[List[int]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "AdaptiveBoundSampler":
+        self._thread = threading.Thread(
+            target=self._loop, name="nemesis-adaptive-sampler",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            clock = self._driver.clock
+            bounds = getattr(clock, "effective_bounds", None)
+            if bounds is not None:
+                try:
+                    self.samples.append(list(bounds()))
+                except Exception:  # clock mid-swap: skip the tick
+                    pass
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
 class StalenessSampler:
     """Polls ``driver.clock.staleness()`` on its own thread while a
     scenario runs (the driver swaps in a fresh clock at run start, so
@@ -291,9 +368,11 @@ class StalenessSampler:
 
 
 __all__ = [
+    "AdaptiveBoundSampler",
     "StalenessSampler",
     "ThreadLedger",
     "Verdict",
+    "check_adaptive_bound",
     "check_count_parity",
     "check_exactly_once",
     "check_lease_staleness",

@@ -52,9 +52,11 @@ from ..replication.driver import (
 from ..telemetry import flightrec
 from ..telemetry.registry import MetricsRegistry
 from .invariants import (
+    AdaptiveBoundSampler,
     StalenessSampler,
     ThreadLedger,
     Verdict,
+    check_adaptive_bound,
     check_exactly_once,
     check_lease_staleness,
     check_lock_inversions,
@@ -183,6 +185,14 @@ def _build_driver(s: Scenario, workload, wal_dir: str, registry):
         retry_timeout=s.retry_timeout,
         connect_timeout=2.0,
     )
+    if s.adaptive:
+        # the straggler-adaptive kill switch (adaptive/): AdaptiveClock
+        # with the derived ceiling, plus hedged pushes — safe on the
+        # elastic drivers because membership-backed pushes carry a pid
+        common.update(
+            adaptive=True,
+            adaptive_push_hedge_after_s=0.05,
+        )
     if s.replicated:
         cfg = ReplicatedClusterConfig(replication_factor=1, **common)
         cls = NemesisReplicatedDriver
@@ -377,6 +387,9 @@ def run_scenario(
     acked = applied = 0
     rounds_done = 0
     samples: List[int] = []
+    bound_samples: List[List[int]] = []
+    adaptive_rt = None
+    adaptive_tl = None
     faults: Dict[str, int] = {}
     inversions: list = []
 
@@ -391,6 +404,28 @@ def run_scenario(
         with capture_cm as w:
             driver = _build_driver(scenario, workload, wal_dir, reg)
             driver.start()
+            if scenario.adaptive:
+                # detection → control: a worker-entity SkewTracker over
+                # the per-worker pull RTT histograms feeds the
+                # AdaptiveRuntime, which drives the driver's
+                # AdaptiveClock allowances through the storm
+                from ..adaptive.controller import AdaptiveRuntime
+                from ..telemetry.timeline import (
+                    SkewTracker, TimelineRecorder,
+                )
+
+                adaptive_tl = TimelineRecorder(
+                    reg, interval_s=0.05,
+                    include=lambda n: n == "cluster_pull_rtt_seconds",
+                    skew=[SkewTracker(
+                        "cluster_pull_rtt_seconds",
+                        entity_label="worker", field="p50",
+                        min_points=2, warmup_evals=2,
+                    )],
+                ).start()
+                adaptive_rt = AdaptiveRuntime(
+                    driver, adaptive_tl, interval_s=0.05, registry=reg,
+                ).start()
 
             def round_hook(worker: int, rnd: int) -> None:
                 with cond:
@@ -495,7 +530,8 @@ def run_scenario(
                 )
                 reader.start()
             try:
-                with StalenessSampler(driver) as sampler:
+                with StalenessSampler(driver) as sampler, \
+                        AdaptiveBoundSampler(driver) as bsampler:
                     try:
                         result = driver.run(
                             batches, round_hook=round_hook, timeout=180
@@ -507,6 +543,7 @@ def run_scenario(
                             f"run: {type(e).__name__}: {e}"
                         )
                 samples = list(sampler.samples)
+                bound_samples = list(bsampler.samples)
             finally:
                 with cond:
                     progress["done"] = True
@@ -521,6 +558,10 @@ def run_scenario(
                     sh.rows_applied for sh in driver.all_shards
                 )
                 faults = driver.faults_injected()
+                if adaptive_rt is not None:
+                    adaptive_rt.stop()
+                if adaptive_tl is not None:
+                    adaptive_tl.stop()
                 driver.stop()
         if witness:
             inversions = list(w.inversions)
@@ -531,11 +572,24 @@ def run_scenario(
             timeline.mark("scenario_end", name=scenario.name)
         flightrec.set_recorder(prev_rec)
 
+    # under the adaptive runtime, widened allowances legally raise the
+    # live spread up to the CEILING (+1 round in flight) — the stock
+    # bound would false-positive on exactly the behaviour the runtime
+    # exists to produce; the ceiling derivation mirrors _make_clock
+    bound = scenario.staleness_bound
+    ceiling = (
+        2 * bound + 1
+        if scenario.adaptive and bound is not None else bound
+    )
     verdicts = [
         check_no_errors(errors),
         check_exactly_once(acked, applied),
-        check_staleness(samples, scenario.staleness_bound),
+        check_staleness(samples, ceiling),
     ]
+    if scenario.adaptive:
+        verdicts.append(
+            check_adaptive_bound(bound_samples, bound, ceiling)
+        )
     if scenario.parity:
         if values is None:
             verdicts.append(Verdict(

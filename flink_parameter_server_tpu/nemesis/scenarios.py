@@ -148,6 +148,14 @@ class Scenario:
     wire_format: str = "b64"
     request_timeout: float = 15.0
     retry_timeout: float = 60.0
+    # straggler-adaptive runtime (adaptive/, docs/adaptive.md): the
+    # driver gets the AdaptiveClock + push hedging kill switch and the
+    # runner attaches a timeline-fed AdaptiveRuntime, samples the
+    # per-worker effective bounds live, and audits the
+    # adaptive_bound_envelope invariant.  The staleness check then
+    # judges the spread against the CEILING (widened allowances
+    # legally raise the spread to ceiling + 1).
+    adaptive: bool = False
     expect: str = "pass"
 
     def __post_init__(self):
@@ -305,7 +313,10 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
     ),
     # 7. slow-shard straggler storm under SSP: one shard's frames are
     # delayed+jittered for a window; the staleness bound must hold
-    # (parity is off — SSP reorders updates by design)
+    # (parity is off — SSP reorders updates by design).  Runs with the
+    # adaptive runtime live: the per-worker effective bounds are
+    # sampled through the storm and the adaptive_bound_envelope
+    # invariant must hold (satellite of ISSUE 19).
     Scenario(
         "straggler_storm_ssp",
         (
@@ -316,6 +327,7 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
         rounds=14,
         staleness_bound=2,
         parity=False,
+        adaptive=True,
     ),
     # 8. mid-frame RST on a pull RESPONSE: the payload is torn
     # mid-frame and the connection reset — the client replays; pulls

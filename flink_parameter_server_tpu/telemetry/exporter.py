@@ -252,6 +252,23 @@ class TelemetryServer(LineServer):
             ) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("adaptive"):
+            # the adaptive runtime's live decision surface (adaptive/
+            # controller.py): per-worker effective bounds + skew
+            # ratios, hedged-push wins, rebalance moves, the decision
+            # ring — `psctl adaptive` renders this.  No runtime
+            # installed answers null (opt-in, like `timeline`)
+            from ..adaptive.controller import get_adaptive_runtime
+
+            rt = get_adaptive_runtime()
+            body = json.dumps(
+                {"adaptive": (
+                    rt.payload() if rt is not None else None
+                ),
+                 "run_id": self.registry.run_id}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         elif path.startswith("workloads"):
             # the live per-workload rate table (workloads/runtime.py):
             # cumulative update/prediction/query counters + query
@@ -269,7 +286,7 @@ class TelemetryServer(LineServer):
             body = (
                 f"unknown path {path!r} "
                 f"(metrics|healthz|hotkeys|hot|budget|conns|"
-                f"timeline|workloads)\n"
+                f"timeline|adaptive|workloads)\n"
             )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"

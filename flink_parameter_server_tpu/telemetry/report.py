@@ -182,6 +182,9 @@ def build_run_report(
     timeline = _timeline_section()
     if timeline is not None:
         report["timeline"] = timeline
+    adaptive = _adaptive_section()
+    if adaptive is not None:
+        report["adaptive"] = adaptive
     if extra:
         report["extra"] = dict(extra)
     return report
@@ -341,6 +344,26 @@ def _timeline_section(max_rows: int = 40) -> Optional[Dict[str, Any]]:
         "anomalies": anomalies,
         "skew": [t.snapshot() for t in tl.skew],
     }
+
+
+def _adaptive_section(
+    max_decisions: int = 40,
+) -> Optional[Dict[str, Any]]:
+    """Adaptive-runtime roll-up (adaptive/controller.py): per-worker
+    effective bounds, hedge wins, rebalance moves, the decision tail —
+    None when no runtime is installed (opt-in, like the timeline)."""
+    from ..adaptive.controller import get_adaptive_runtime
+
+    rt = get_adaptive_runtime()
+    if rt is None:
+        return None
+    payload = rt.payload()
+    decisions = payload.pop("decisions", [])
+    payload["decisions"] = decisions[-max_decisions:]
+    payload["decisions_truncated"] = max(
+        0, len(decisions) - max_decisions
+    )
+    return payload
 
 
 def _default_platform() -> str:

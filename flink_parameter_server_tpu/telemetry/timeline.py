@@ -389,6 +389,13 @@ class TimelineRecorder:
         cursor into this to turn NEW firings into scale pressure)."""
         return list(self._anomalies)
 
+    def anomalies_since(self, cursor: int) -> Tuple[List[dict], int]:
+        """The ledger entries appended since ``cursor`` plus the new
+        cursor — the one-liner both the elastic and adaptive
+        controllers use so neither re-consumes an old firing."""
+        ledger = list(self._anomalies)
+        return ledger[cursor:], len(ledger)
+
     def series(self, metric: Optional[str] = None) -> List[dict]:
         with self._lock:
             items = list(self._series.items())

@@ -25,8 +25,9 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Six further artifact shapes from the observability plane lint here
-too (docs/observability.md, docs/loadgen.md, docs/meshstore.md):
+Seven further artifact shapes from the observability plane lint here
+too (docs/observability.md, docs/loadgen.md, docs/meshstore.md,
+docs/adaptive.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
     python tools/check_metric_lines.py --flightrec flightrec_stall.json
@@ -34,6 +35,7 @@ too (docs/observability.md, docs/loadgen.md, docs/meshstore.md):
     python tools/check_metric_lines.py --soak soak_capacity.json
     python tools/check_metric_lines.py --mesh-ab mesh_backend_ab.json
     python tools/check_metric_lines.py --timeline soak_timeline.json
+    python tools/check_metric_lines.py --straggler-ab straggler_ab.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -65,8 +67,16 @@ under ``arms``/``timelines``): every series' timestamps are monotone
 non-decreasing, the sampling cadence holds (median inter-point gap
 within 3x the declared ``interval_s`` — a jittering sampler quietly
 voids rate math), and every anomaly record cross-references a metric
-the artifact actually carries a series for.  A mode flag applies to
-the paths that follow it.
+the artifact actually carries a series for.  ``--straggler-ab``
+checks a straggler-adaptive A/B artifact
+(benchmarks/straggler_ab.py, docs/adaptive.md): ts/run_id stamped,
+every workload carries BOTH arms (``adaptive`` and ``fixed`` — same
+chaos, same deadline) with numeric goodput and final-table RMSE, the
+goodput ratio is recorded at workload level, the adaptive arm counts
+every mechanism's firings (a "win" with zero widenings/hedges/moves
+means the control loop never ran), and the bound-envelope invariant
+is green (effective bounds stayed inside [bound, ceiling]).  A mode
+flag applies to the paths that follow it.
 """
 from __future__ import annotations
 
@@ -82,7 +92,7 @@ KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
      "replication", "nemesis", "hotcache", "loadgen", "compression",
-     "workloads", "shmem", "meshstore", "timeline"}
+     "workloads", "shmem", "meshstore", "timeline", "adaptive"}
 )
 
 
@@ -532,6 +542,99 @@ def check_timeline(doc: Any) -> List[str]:
     return bad
 
 
+# every adaptive mechanism the A/B must account for — an arm that
+# "won" without a single widening, hedge or move proves only that the
+# chaos never bit, so the counts travel with the number
+_STRAGGLER_AB_MECHANISMS = (
+    "widenings", "narrowings", "hedged_pushes", "push_hedges_won",
+    "rebalances",
+)
+
+
+def check_straggler_ab(doc: Any) -> List[str]:
+    """Lint a straggler-adaptive A/B artifact
+    (benchmarks/straggler_ab.py format, docs/adaptive.md)."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"straggler-ab document is {type(doc).__name__}, "
+                f"expected a JSON object"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        bad.append("missing/non-numeric 'ts'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    ab = doc.get("straggler_ab")
+    if not isinstance(ab, dict):
+        bad.append("missing/non-object 'straggler_ab'")
+        return bad
+    workloads = ab.get("workloads")
+    if not isinstance(workloads, dict) or not workloads:
+        bad.append("missing/empty 'straggler_ab.workloads'")
+        return bad
+    for wname, wl in workloads.items():
+        if not isinstance(wl, dict):
+            bad.append(f"workload {wname!r}: not an object")
+            continue
+        arms = wl.get("arms")
+        if not isinstance(arms, dict):
+            bad.append(f"workload {wname!r}: missing/non-object 'arms'")
+            continue
+        for required in ("adaptive", "fixed"):
+            if required not in arms:
+                bad.append(
+                    f"workload {wname!r}: arm {required!r} missing — "
+                    f"the A/B requires BOTH arms under the same chaos "
+                    f"and deadline"
+                )
+        for aname, arm in arms.items():
+            if not isinstance(arm, dict):
+                bad.append(f"workload {wname!r} arm {aname!r}: not an "
+                           f"object")
+                continue
+            for field in ("goodput_eps", "rmse"):
+                if not isinstance(arm.get(field), (int, float)):
+                    bad.append(
+                        f"workload {wname!r} arm {aname!r}: "
+                        f"missing/non-numeric {field!r}"
+                    )
+        if not isinstance(wl.get("goodput_ratio"), (int, float)):
+            bad.append(
+                f"workload {wname!r}: missing/non-numeric "
+                f"'goodput_ratio' (adaptive/fixed — the headline "
+                f"number must be recorded, not recomputed downstream)"
+            )
+        adaptive = arms.get("adaptive") if isinstance(arms, dict) else None
+        if isinstance(adaptive, dict):
+            mech = adaptive.get("mechanisms")
+            if not isinstance(mech, dict):
+                bad.append(
+                    f"workload {wname!r}: adaptive arm missing "
+                    f"'mechanisms' — every mechanism's firings must "
+                    f"be counted"
+                )
+            else:
+                for m in _STRAGGLER_AB_MECHANISMS:
+                    v = mech.get(m)
+                    if not isinstance(v, int) or v < 0:
+                        bad.append(
+                            f"workload {wname!r}: mechanisms[{m!r}] "
+                            f"must be a non-negative integer (got "
+                            f"{v!r})"
+                        )
+            env = adaptive.get("bound_envelope")
+            if not isinstance(env, dict):
+                bad.append(
+                    f"workload {wname!r}: adaptive arm missing "
+                    f"'bound_envelope'"
+                )
+            elif env.get("ok") is not True:
+                bad.append(
+                    f"workload {wname!r}: bound_envelope.ok is not "
+                    f"true — the ceiling invariant must be green for "
+                    f"the goodput number to count"
+                )
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -560,6 +663,8 @@ def main(argv: List[str]) -> int:
             mode = "mesh_ab"
         elif a == "--timeline":
             mode = "timeline"
+        elif a == "--straggler-ab":
+            mode = "straggler_ab"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -570,13 +675,13 @@ def main(argv: List[str]) -> int:
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
               "[--trace|--flightrec|--budget|--soak|--mesh-ab|"
-              "--timeline|--lines] <file|-> ...",
+              "--timeline|--straggler-ab|--lines] <file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
         if mode in ("trace", "flightrec", "budget", "soak", "mesh_ab",
-                    "timeline"):
+                    "timeline", "straggler_ab"):
             checker = {
                 "trace": check_trace_events,
                 "flightrec": check_flightrec,
@@ -584,6 +689,7 @@ def main(argv: List[str]) -> int:
                 "soak": check_soak,
                 "mesh_ab": check_mesh_ab,
                 "timeline": check_timeline,
+                "straggler_ab": check_straggler_ab,
             }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:

@@ -26,6 +26,7 @@ Usage::
     psctl watch  --metrics HOST:PORT [--interval 2] [--iterations 0]
                  [-n 16] [--raw]
     psctl timeline METRIC --metrics HOST:PORT [--json]
+    psctl adaptive --metrics HOST:PORT [--json] [-n 10]
 
 ``top`` is the `top(1)` of the cluster: it scrapes ``/metrics`` every
 ``--interval`` seconds, derives rates from counter deltas (updates/sec,
@@ -96,6 +97,14 @@ sparkline of the series tail, followed by the recorder's anomaly
 ledger entries for that metric.  Accepts the bare registry name or
 the ``fps_``-prefixed exporter name; ``--json`` emits the filtered
 payload.
+
+``adaptive`` renders the straggler-adaptive runtime's live state from
+the telemetry endpoint's ``adaptive`` path (a process-installed
+``AdaptiveRuntime``, adaptive/controller.py): a header with the base
+bound, ceiling, widen/narrow counts, hedged-push win rate and
+rebalance moves, one table row per worker (effective bound × skew
+ratio), and the tail of the decision ring — what the control loop did
+and why, without a log dive.  ``--json`` emits the raw payload.
 
 ``stats`` asks each shard for its one-line JSON stats (rows, pulls,
 pushes, restarts, epoch, WAL depth, dedupe-window size) and renders one
@@ -944,6 +953,62 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_adaptive(args) -> int:
+    host, port = parse_addr(args.metrics)
+    try:
+        doc = json.loads(scrape(host, port, "adaptive"))
+    except OSError as e:
+        print(f"psctl: {args.metrics} unreachable: {e}", file=sys.stderr)
+        return 1
+    ad = doc.get("adaptive")
+    if ad is None:
+        print("psctl: no AdaptiveRuntime installed on this process "
+              "(adaptive.controller.set_adaptive_runtime)",
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(
+            {"adaptive": ad, "run_id": doc.get("run_id")}, indent=2,
+        ))
+        return 0
+    hedge = ad.get("hedge") or {}
+    issued = hedge.get("issued") or 0
+    won = hedge.get("won") or 0
+    win_rate = f"{won / issued:.2%}" if issued else "—"
+    reb = ad.get("rebalance") or {}
+    counts = ad.get("counts") or {}
+    print(
+        f"psctl adaptive — base_bound={ad.get('base_bound')} "
+        f"ceiling={ad.get('bound_ceiling')} ticks={ad.get('ticks')} — "
+        f"widen={counts.get('widenings', 0)} "
+        f"narrow={counts.get('narrowings', 0)} "
+        f"hedged pushes={issued} won={won} ({win_rate}) "
+        f"rebalances={reb.get('moves', 0)}"
+    )
+    rows = [
+        [str(w.get("worker")), str(w.get("effective_bound")),
+         f"{w.get('skew_ratio', 1.0):.3g}"]
+        for w in ad.get("workers", [])
+    ]
+    if rows:
+        print(_render_table(
+            ["worker", "effective bound", "skew ratio"], rows
+        ))
+    else:
+        print("(no adaptive clock live — between runs, or the kill "
+              "switch is off)")
+    decisions = ad.get("decisions") or []
+    if decisions:
+        print(f"\nlast {min(len(decisions), args.n)} decision(s):")
+        for d in decisions[-args.n:]:
+            extra = {
+                k: v for k, v in d.items()
+                if k not in ("ts", "action")
+            }
+            print(f"  ts={d.get('ts')}  {d.get('action')}  {extra}")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="psctl", description=__doc__,
@@ -1051,6 +1116,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     tlp.add_argument("--json", action="store_true",
                      help="emit the filtered payload")
     tlp.set_defaults(fn=cmd_timeline)
+
+    adp = sub.add_parser(
+        "adaptive",
+        help="straggler-adaptive runtime: bounds, hedges, rebalances",
+    )
+    adp.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    adp.add_argument("--json", action="store_true",
+                     help="emit the raw adaptive payload")
+    adp.add_argument("-n", type=int, default=10,
+                     help="decision rows to show (default 10)")
+    adp.set_defaults(fn=cmd_adaptive)
 
     bu = sub.add_parser("budget", help="latency-budget phase table")
     bu.add_argument("--metrics", required=True, metavar="HOST:PORT")
