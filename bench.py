@@ -1373,6 +1373,54 @@ def _emit_straggler_metric(platform: str, fallback: bool) -> None:
         }))
 
 
+def _emit_tier_metric(platform: str, fallback: bool) -> None:
+    """Sixteenth (opt-in) metric line: the two-tier store soak.
+
+    FPS_BENCH_TIER=1 runs benchmarks/tierstore_soak.py — the Criteo-
+    scale arms (2^24 rows) under a Zipf mix, tiered vs all-RAM, plus
+    the correctness legs (bitwise parity, kill→promote, WAL replay
+    through cold rows, elastic migration; docs/tierstore.md); the
+    metric is the hot-path pull-latency ratio (bar: <= 2x at a
+    recorded peak-RSS bound) — and writes
+    ``results/cpu/tierstore_soak.{md,json}``, the artifact linted by
+    ``tools/check_metric_lines.py --tier``.  Default 0; failure
+    degrades to a value-None line like every other guarded line."""
+    raw = os.environ.get("FPS_BENCH_TIER", "0")
+    if raw not in ("0", "1"):
+        raise SystemExit(f"FPS_BENCH_TIER={raw!r}: 0|1")
+    if raw == "0":
+        return
+    metric = "tierstore pull latency ratio at bounded RSS"
+    if fallback:
+        metric += " [CPU FALLBACK: TPU tunnel unresponsive]"
+    try:
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "tierstore_soak.py")],
+            capture_output=True, text=True, timeout=570,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        if not lines:
+            raise RuntimeError(
+                f"no output (rc={proc.returncode}): "
+                f"{proc.stderr.strip()[-200:]}"
+            )
+        payload = json.loads(lines[-1])
+        payload["metric"] = metric
+        print(json.dumps(payload))
+    except Exception as e:  # noqa: BLE001 — degraded line beats no line
+        print(json.dumps({
+            "metric": metric,
+            "value": None,
+            "unit": "x slowdown (tiered / all-RAM pull p50)",
+            "error": f"{type(e).__name__}: {e}",
+        }))
+
+
 def main():
     platform = _ensure_backend_alive()
     fallback = os.environ.get("FPS_BENCH_CPU_FALLBACK") == "1"
@@ -1409,6 +1457,7 @@ def main():
             _emit_mesh_metric(platform, fallback)
             _emit_timeline_metric(platform, fallback)
             _emit_straggler_metric(platform, fallback)
+            _emit_tier_metric(platform, fallback)
             return
     r = tpu_updates_per_sec()
     cpu_rate, baseline_finite = cpu_per_record_baseline(dim=r["dim"])
@@ -1472,6 +1521,7 @@ def main():
     _emit_mesh_metric(platform, fallback)
     _emit_timeline_metric(platform, fallback)
     _emit_straggler_metric(platform, fallback)
+    _emit_tier_metric(platform, fallback)
 
 
 if __name__ == "__main__":

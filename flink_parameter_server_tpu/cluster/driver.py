@@ -72,8 +72,21 @@ class ClusterConfig:
     # lowered to jitted gather/scatter-add — the wire knobs (window,
     # chunk, wire_format, wire_proto, spawn_grace_s, host, timeouts)
     # are then inert, and num_shards becomes layout arithmetic (the
-    # block-aligned range partition) rather than a server count
+    # block-aligned range partition) rather than a server count;
+    # "tiered" = the socket topology with each shard's slice on the
+    # two-tier hot/cold store (tierstore/, docs/tierstore.md) — hot
+    # rows dense, cold mutated rows in an mmap slab, absent rows
+    # recomputed from the deterministic init, RSS bounded by
+    # tier_hot_rows instead of the table size
     store_backend: str = "socket"
+    # tiered-store knobs (read only when store_backend="tiered"):
+    # hot-tier capacity per shard in rows; the slab scratch dir (None
+    # = the platform tmpdir — the slab is a cache, never a durability
+    # plane, so it does NOT belong beside the WAL); the sketch decay
+    # window in observed ids (0 derives 8 × tier_hot_rows)
+    tier_hot_rows: int = 65536
+    tier_slab_dir: Optional[str] = None
+    tier_decay_window: int = 0
     # 0 = BSP (parity with the single-process driver), k > 0 = SSP,
     # None = fully asynchronous (never block)
     staleness_bound: Optional[int] = 0
@@ -236,9 +249,16 @@ class ClusterDriver:
         self.value_shape = tuple(int(s) for s in value_shape)
         self.config = config if config is not None else ClusterConfig()
         cfg = self.config
-        if cfg.store_backend not in ("socket", "mesh"):
+        if cfg.store_backend not in ("socket", "mesh", "tiered"):
             raise ValueError(
-                f"store_backend={cfg.store_backend!r}: 'socket' | 'mesh'"
+                f"store_backend={cfg.store_backend!r}: "
+                f"'socket' | 'mesh' | 'tiered'"
+            )
+        if cfg.store_backend == "tiered" and cfg.shard_procs:
+            raise ValueError(
+                "store_backend='tiered' with shard_procs=True: shard "
+                "worker processes run the jax-free numpy slice "
+                "(cluster/procs.py); tiered shards are in-process"
             )
         if cfg.store_backend == "mesh":
             # the mesh backend slots under the BASE driver's contracts
@@ -406,6 +426,15 @@ class ClusterDriver:
             registry=self.registry if self.registry is not None else False,
             hotkeys=hotkeys,
             profiler=None if cfg.profile else False,
+            # the "tiered" cluster backend IS the socket topology with
+            # tiered slices — elastic scale-out and replacement shards
+            # built here inherit the tier automatically
+            store_backend=(
+                "tiered" if cfg.store_backend == "tiered" else "jax"
+            ),
+            tier_hot_rows=cfg.tier_hot_rows,
+            tier_slab_dir=cfg.tier_slab_dir,
+            tier_decay_window=cfg.tier_decay_window,
         )
         server = ShardServer(
             shard, cfg.host, 0, supervised=cfg.supervised, tracer=tracer

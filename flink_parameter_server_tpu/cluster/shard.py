@@ -258,7 +258,12 @@ class _NumpyStore:
     __slots__ = ("_v",)
 
     def __init__(self, values: np.ndarray):
-        self._v = np.asarray(values)
+        v = np.asarray(values)
+        if not v.flags.writeable:
+            # np.asarray over a jax-rendered init is a zero-copy
+            # READ-ONLY view; push mutates in place
+            v = v.copy()
+        self._v = v
 
     @classmethod
     def from_values(cls, values) -> "_NumpyStore":
@@ -287,10 +292,13 @@ class ParamShard:
 
     ``store_backend`` picks the slice's array runtime: ``"jax"`` (the
     default — the mesh-sharded store path every in-process topology
-    uses) or ``"numpy"`` (plain host arrays; what shard worker
-    PROCESSES run — see :class:`_NumpyStore`).  Both apply identical
-    fp32 scatter-adds over client-deduplicated ids, so the slices stay
-    bitwise-comparable.
+    uses), ``"numpy"`` (plain host arrays; what shard worker
+    PROCESSES run — see :class:`_NumpyStore`), or ``"tiered"`` (hot
+    rows dense, cold rows in an mmap slab, absent rows recomputed
+    from the deterministic init — :mod:`~..tierstore`, the
+    bounded-RSS backend for tables that don't fit RAM).  All apply
+    identical fp32 scatter-adds over client-deduplicated ids, so the
+    slices stay bitwise-comparable.
     """
 
     def __init__(
@@ -307,12 +315,24 @@ class ParamShard:
         hotkeys=None,
         profiler=None,
         store_backend: str = "jax",
+        tier_hot_rows: int = 65536,
+        tier_slab_dir: Optional[str] = None,
+        tier_decay_window: int = 0,
     ):
-        if store_backend not in ("jax", "numpy"):
+        if store_backend not in ("jax", "numpy", "tiered"):
             raise ValueError(
-                f"store_backend={store_backend!r}: 'jax' | 'numpy'"
+                f"store_backend={store_backend!r}: "
+                f"'jax' | 'numpy' | 'tiered'"
+            )
+        if store_backend == "tiered" and dtype is not None:
+            raise ValueError(
+                "store_backend='tiered' is fp32-only (the tiers must "
+                "stay bitwise-comparable with the dense backends)"
             )
         self._backend = store_backend
+        self._tier_hot_rows = int(tier_hot_rows)
+        self._tier_slab_dir = tier_slab_dir
+        self._tier_decay_window = int(tier_decay_window)
         self.shard_id = int(shard_id)
         self.partitioner = partitioner
         self.value_shape = tuple(int(s) for s in value_shape)
@@ -409,12 +429,90 @@ class ParamShard:
             )
         else:
             self._c_pulls = self._c_pushes = self._c_restarts = None
+        if self._backend == "tiered":
+            from ..tierstore import metrics as tier_metrics
+
+            tier_metrics.register_store(self._tier_label(), self.tier_stats)
+            if registry is not False:
+                tier_metrics.register_instruments(
+                    reg, str(self.shard_id), self.tier_stats
+                )
+
+    # -- the tiered backend (tierstore/, docs/tierstore.md) ------------------
+    def _tier_label(self) -> str:
+        """The shard's name on the process-wide ``tiers`` snapshot
+        registry; followers append their chain index."""
+        fidx = getattr(self, "follower_idx", None)
+        label = f"shard-{self.shard_id}"
+        return label if fidx is None else f"{label}-f{fidx}"
+
+    def _tier_row_init(self, local_ids: np.ndarray) -> np.ndarray:
+        """Deterministic init for LOCAL rows — row j is the global
+        table's row ``owned[j]``, same per-id contract as
+        :meth:`_build`, so a recomputed cold miss is bitwise the row a
+        dense backend would have materialised."""
+        gids = np.asarray(self.owned)[np.asarray(local_ids, np.int64)]
+        if self._init_fn is None:
+            return np.zeros(gids.shape + self.value_shape, np.float32)
+        return np.asarray(self._init_fn(gids), np.float32)
+
+    def _tier_pinned_local(self) -> np.ndarray:
+        """Local ids the tier must never evict: keys frozen for an
+        in-flight migration plus every currently-leased key (a lease
+        is an invalidation promise — the row is about to be read or
+        written again).  Runs under the shard lock during eviction
+        scans; the lease board's lock nests strictly under it."""
+        gids = self.leases.leased_ids()
+        if self._frozen is not None:
+            gids = np.union1d(gids, self._frozen)
+        if gids.size == 0:
+            return gids
+        gids = gids[self.partitioner.shard_of(gids) == self.shard_id]
+        if gids.size == 0:
+            return gids
+        return self.partitioner.to_local(self.shard_id, gids)
+
+    def _make_tier_store(self):
+        from ..tierstore.store import TieredStore
+
+        return TieredStore(
+            len(self.owned),
+            self.value_shape,
+            row_init=self._tier_row_init,
+            hot_rows=self._tier_hot_rows,
+            slab_dir=self._tier_slab_dir,
+            decay_window=self._tier_decay_window,
+            pinned_fn=self._tier_pinned_local,
+            name_hint=self._tier_label(),
+        )
+
+    def tier_stats(self):
+        """The tier's instrument snapshot (``None`` on non-tiered
+        backends or while crashed) — the ``component=tierstore`` gauge
+        source and the TelemetryServer ``tiers`` path payload."""
+        with self._lock:
+            if self._backend != "tiered" or self.store is None:
+                return None
+            st = self.store.stats()
+            st["shard"] = self.shard_id
+            st["role"] = self.role
+            return st
 
     # -- construction / recovery -------------------------------------------
     def _store_from_values(self, values):
         """Build a store of the configured backend over ``values`` —
         the one seam every slice (re)materialisation goes through, so
         the jax/numpy choice lives in exactly one place."""
+        if self._backend == "tiered":
+            # snapshot-restore / epoch-install: seed a FRESH tier from
+            # the dense rows (only rows differing from init hit the
+            # slab) and retire the old slab file
+            old = self.store
+            st = self._make_tier_store()
+            st.seed_dense(np.asarray(values, np.float32))
+            if old is not None and hasattr(old, "close"):
+                old.close()
+            return st
         if self._backend == "numpy":
             return _NumpyStore.from_values(np.asarray(values))
         import jax.numpy as jnp
@@ -431,6 +529,15 @@ class ParamShard:
         :func:`~..core.store.create_table`).  Under the numpy backend
         ``init_fn`` receives (and must return) host arrays — shard
         worker processes never import jax."""
+        if self._backend == "tiered":
+            # NO dense materialisation: the whole point of the tier is
+            # that init is recomputable per id — the store starts empty
+            # and rows appear as traffic (or WAL replay) touches them
+            if self.store is not None and hasattr(self.store, "close"):
+                self.store.close()
+            self.store = self._make_tier_store()
+            self._host_mirror = None
+            return
         if self._backend == "numpy":
             ids = np.asarray(self.owned, np.int64)
             if self._init_fn is not None:
@@ -524,10 +631,12 @@ class ParamShard:
 
     def _apply(self, global_ids: np.ndarray, deltas: np.ndarray) -> None:
         local = self.partitioner.to_local(self.shard_id, global_ids)
-        if self._backend == "numpy":
+        if self._backend in ("numpy", "tiered"):
             # host scatter-add in place: no shape-specialised kernels,
             # so no pow2 bucketing either — padding existed for XLA's
-            # compile cache, and numpy has none to warm
+            # compile cache, and numpy has none to warm.  (The tiered
+            # push ensures residency first; rows the hot tier cannot
+            # take write through to the slab.)
             self.store.push(local, deltas)
             self._host_mirror = None
             self.pushes_applied += 1
@@ -569,6 +678,13 @@ class ParamShard:
             self._staged[int(gid)] = np.array(row, np.float32)
         if mine.any():
             local = self.partitioner.to_local(self.shard_id, ids[mine])
+            if self._backend == "tiered":
+                # in-place tier write: resident rows update hot (and
+                # dirty), cold rows go straight to the slab — a bulk
+                # migration load must not thrash the hot tier or
+                # materialise the dense table
+                self.store.assign(local, values[mine])
+                return
             # assign through the host mirror: a bulk load arrives in
             # many chunks, and a device round trip per chunk would
             # dominate migration wall time; jnp.asarray copies the
@@ -598,6 +714,19 @@ class ParamShard:
         if self.store is None:
             raise ShardCrashed(f"shard {self.shard_id} has no live slice")
 
+    def _rows(self, local: np.ndarray) -> np.ndarray:
+        """Read rows by LOCAL index — the pull-side table access.
+        Dense backends go through the lazily-rebuilt host mirror (one
+        fancy-index per request); the tiered backend gathers through
+        the hot tier (misses promote from slab/init) and must NEVER
+        materialise the dense mirror — that allocation is exactly the
+        RSS the tier exists to avoid."""
+        if self._backend == "tiered":
+            return self.store.gather(local)
+        if self._host_mirror is None:
+            self._host_mirror = np.asarray(self.store.values())
+        return self._host_mirror[local]
+
     def _route(self, ids: np.ndarray, epoch: Optional[int]) -> np.ndarray:
         """``to_local`` with epoch-aware failure: a routing miss under a
         mismatched frame epoch is the mixed-flight flip, not a protocol
@@ -626,11 +755,9 @@ class ParamShard:
             ids = np.asarray(global_ids, np.int64)
             local = self._route(ids, epoch)
             with prof.timer("pull", "scatter_apply"):
-                # the pull-side table access: (re)build the host mirror
-                # if a push invalidated it, then one fancy-index gather
-                if self._host_mirror is None:
-                    self._host_mirror = np.asarray(self.store.values())
-                vals = self._host_mirror[local]
+                # the pull-side table access: host-mirror fancy-index
+                # (dense backends) or a tier gather (see _rows)
+                vals = self._rows(local)
             self.pulls_served += 1
             if self.hotkeys is not None:
                 self.hotkeys.observe(ids)
@@ -671,9 +798,7 @@ class ParamShard:
             ids = np.asarray(global_ids, np.int64)
             local = self._route(ids, epoch)
             with prof.timer("pull", "scatter_apply"):
-                if self._host_mirror is None:
-                    self._host_mirror = np.asarray(self.store.values())
-                vals = self._host_mirror[local].copy()
+                vals = self._rows(local).copy()
             self.pulls_served += 1
             if self.hotkeys is not None:
                 self.hotkeys.observe(ids)
@@ -801,9 +926,7 @@ class ParamShard:
             local = self.partitioner.to_local(
                 self.shard_id, np.asarray(global_ids, np.int64)
             )
-            if self._host_mirror is None:
-                self._host_mirror = np.asarray(self.store.values())
-            return self._host_mirror[local].copy(), self._push_seq
+            return self._rows(local).copy(), self._push_seq
 
     def assign_rows(
         self, global_ids: np.ndarray, values: np.ndarray
@@ -939,16 +1062,21 @@ class ParamShard:
         with self._lock:
             self._check_alive()
             ids = np.asarray(global_ids, np.int64)
-            if self._host_mirror is None:
-                self._host_mirror = np.asarray(self.store.values())
             mine = self.partitioner.shard_of(ids) == self.shard_id
-            out = np.empty(
-                (len(ids),) + self._host_mirror.shape[1:],
-                self._host_mirror.dtype,
-            )
+            if self._backend == "tiered":
+                out = np.empty(
+                    (len(ids),) + self.value_shape, np.float32
+                )
+            else:
+                if self._host_mirror is None:
+                    self._host_mirror = np.asarray(self.store.values())
+                out = np.empty(
+                    (len(ids),) + self._host_mirror.shape[1:],
+                    self._host_mirror.dtype,
+                )
             if mine.any():
                 local = self.partitioner.to_local(self.shard_id, ids[mine])
-                out[mine] = self._host_mirror[local]
+                out[mine] = self._rows(local)
             for j in np.nonzero(~mine)[0]:
                 gid = int(ids[j])
                 if gid not in self._staged:
@@ -1043,6 +1171,11 @@ class ParamShard:
         is the durable part).  Every subsequent request raises
         :class:`ShardCrashed` until :meth:`restart`."""
         with self._lock:
+            if self._backend == "tiered" and self.store is not None:
+                # the slab is part of the slice (a cache, not a
+                # durability plane) — a crash loses it with the hot
+                # rows, and replay repopulates the mutated set
+                self.store.close()
             self.store = None
             self._host_mirror = None
 
@@ -1064,7 +1197,7 @@ class ParamShard:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "shard": self.shard_id,
                 "role": self.role,
                 "rows": int(len(self.owned)),
@@ -1091,8 +1224,19 @@ class ParamShard:
                 "lease_sessions": self.leases.sessions(),
                 "leases_active": self.leases.active_leases(),
             }
+            if self._backend == "tiered" and self.store is not None:
+                out["tier"] = self.store.stats()
+            return out
 
     def close(self) -> None:
+        if self._backend == "tiered":
+            from ..tierstore import metrics as tier_metrics
+
+            tier_metrics.unregister_store(self._tier_label())
+            with self._lock:
+                if self.store is not None:
+                    self.store.close()
+                    self.store = None
         if self._wal is not None:
             self._wal.close()
 

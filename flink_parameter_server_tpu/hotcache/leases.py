@@ -267,6 +267,19 @@ class LeaseBoard:
             held = self._grants.get(str(sess))
             return held is not None and int(gid) in held
 
+    def leased_ids(self) -> np.ndarray:
+        """Every currently-leased global id (union over sessions) —
+        what the tiered store pins hot (tierstore/): a leased row is
+        an invalidation promise, so demoting it buys nothing.  Callers
+        may hold the shard lock (this lock nests strictly under it)."""
+        with self._lock:
+            if not self._grants:
+                return np.zeros(0, np.int64)
+            ids = set()
+            for held in self._grants.values():
+                ids.update(held)
+            return np.fromiter(ids, np.int64, len(ids))
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {

@@ -254,6 +254,11 @@ class SoakConfig:
     serve_timeout_s: float = 0.4
     serve_retry_timeout_s: float = 2.0
     serving_error_budget: int = 0
+    # two-tier parameter store (tierstore/, docs/tierstore.md): the
+    # shard slices run hot-in-RAM / cold-in-mmap at a bounded resident
+    # set.  Purely a store swap — same WAL, same wire, same ledger.
+    tiered: bool = False
+    tier_hot_rows: int = 4096
     seed: int = 0
 
 
@@ -359,6 +364,8 @@ class SoakRunner:
         driver = build_cluster_driver(
             self.workload,
             config=ReplicatedClusterConfig(
+                store_backend=("tiered" if cfg.tiered else "socket"),
+                tier_hot_rows=cfg.tier_hot_rows,
                 num_shards=cfg.num_shards,
                 num_workers=1,
                 staleness_bound=None,  # serve-side async clock

@@ -29,6 +29,10 @@ The invariants, and why each is the right oracle:
   * **serving error budget** — a reader thread issuing pulls through
     its own membership client across the whole scenario sees at most
     ``budget`` errors (default 0: faults are latency, never failures).
+  * **tier residency** — on tiered scenarios (tierstore/), every live
+    sample of every tiered store shows ``resident ≤ hot capacity``:
+    demotion pressure, spills and recovery replays may move rows
+    between tiers but never grow the bounded hot set.
   * **no leaked threads** — after teardown every thread the PS stack
     spawned (shards, pumps, workers, shippers, controllers) is gone;
     a fault that orphans a handler fails here, not three suites later.
@@ -253,6 +257,42 @@ def check_lease_staleness(
     )
 
 
+def check_tier_residency(samples: Sequence[dict]) -> Verdict:
+    """The two-tier store's bounded-residency contract (tierstore/,
+    docs/tierstore.md): at EVERY live sample, every tiered shard's
+    resident (hot) row count stays within its configured hot capacity
+    — through demotion storms, kills, promotions and WAL replays,
+    because oversized admissions spill write-through to the cold slab
+    instead of growing the hot tier.  Each sample is
+    ``{label: (resident_rows, hot_capacity_rows)}`` as collected by
+    :class:`TierResidencySampler`.  Vacuous passes are rejected: at
+    least one sample from at least one live tiered store must have
+    been taken, otherwise the scenario never exercised the tier it
+    claims to prove."""
+    n = 0
+    worst_over = 0
+    worst_label = ""
+    peak = 0
+    cap_seen = 0
+    for sample in samples:
+        for label, (resident, cap) in sample.items():
+            n += 1
+            peak = max(peak, int(resident))
+            cap_seen = max(cap_seen, int(cap))
+            over = int(resident) - int(cap)
+            if over > worst_over:
+                worst_over = over
+                worst_label = str(label)
+    ok = n > 0 and worst_over <= 0
+    return Verdict(
+        "tier_residency", ok,
+        f"samples={n} peak_resident={peak} hot_capacity={cap_seen}"
+        + ("" if worst_over <= 0 else
+           f" — CAPACITY EXCEEDED by {worst_over} rows on {worst_label}")
+        + ("" if n else " — never sampled (vacuous)"),
+    )
+
+
 def check_lock_inversions(inversions) -> Verdict:
     n = len(inversions)
     return Verdict(
@@ -333,6 +373,54 @@ class AdaptiveBoundSampler:
             self._thread.join(timeout=5)
 
 
+class TierResidencySampler:
+    """Polls every live tiered store's ``(resident, capacity)`` pair
+    while a scenario runs, through the process-wide tiers snapshot
+    registry (tierstore/metrics.py) — which is what covers chain
+    FOLLOWERS too, not just the shards the driver lists.  A store
+    mid-crash/restart yields no entry for that tick (its stats
+    callable answers ``None``); a non-tiered scenario leaves
+    ``samples`` empty and :func:`check_tier_residency` then rejects
+    the run as vacuous."""
+
+    def __init__(self, interval_s: float = 0.005):
+        self._interval = float(interval_s)
+        self.samples: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def __enter__(self) -> "TierResidencySampler":
+        self._thread = threading.Thread(
+            target=self._loop, name="nemesis-tier-sampler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from ..tierstore.metrics import tiers_snapshot
+
+        while not self._stop.wait(self._interval):
+            snap = tiers_snapshot()
+            if not snap:
+                continue
+            tick = {}
+            for label, st in snap.items():
+                try:
+                    tick[label] = (
+                        int(st["resident_rows"]),
+                        int(st["hot_capacity_rows"]),
+                    )
+                except (KeyError, TypeError, ValueError):
+                    continue
+            if tick:
+                self.samples.append(tick)
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
 class StalenessSampler:
     """Polls ``driver.clock.staleness()`` on its own thread while a
     scenario runs (the driver swaps in a fresh clock at run start, so
@@ -371,6 +459,7 @@ __all__ = [
     "AdaptiveBoundSampler",
     "StalenessSampler",
     "ThreadLedger",
+    "TierResidencySampler",
     "Verdict",
     "check_adaptive_bound",
     "check_count_parity",
@@ -382,4 +471,5 @@ __all__ = [
     "check_parity_bitwise",
     "check_serving_budget",
     "check_staleness",
+    "check_tier_residency",
 ]

@@ -55,6 +55,7 @@ from .invariants import (
     AdaptiveBoundSampler,
     StalenessSampler,
     ThreadLedger,
+    TierResidencySampler,
     Verdict,
     check_adaptive_bound,
     check_exactly_once,
@@ -63,6 +64,7 @@ from .invariants import (
     check_no_errors,
     check_serving_budget,
     check_staleness,
+    check_tier_residency,
 )
 
 # the cached reader's staleness bound, in ticks (1 tick = 1 reader
@@ -192,6 +194,14 @@ def _build_driver(s: Scenario, workload, wal_dir: str, registry):
         common.update(
             adaptive=True,
             adaptive_push_hedge_after_s=0.05,
+        )
+    if s.tiered:
+        # the two-tier store (tierstore/): hot tier deliberately
+        # smaller than the slice, so the schedule's recovery paths
+        # must cross the mmap cold slab
+        common.update(
+            store_backend="tiered",
+            tier_hot_rows=s.tier_hot_rows,
         )
     if s.replicated:
         cfg = ReplicatedClusterConfig(replication_factor=1, **common)
@@ -388,6 +398,7 @@ def run_scenario(
     rounds_done = 0
     samples: List[int] = []
     bound_samples: List[List[int]] = []
+    tier_samples: List[dict] = []
     adaptive_rt = None
     adaptive_tl = None
     faults: Dict[str, int] = {}
@@ -531,7 +542,8 @@ def run_scenario(
                 reader.start()
             try:
                 with StalenessSampler(driver) as sampler, \
-                        AdaptiveBoundSampler(driver) as bsampler:
+                        AdaptiveBoundSampler(driver) as bsampler, \
+                        TierResidencySampler() as tsampler:
                     try:
                         result = driver.run(
                             batches, round_hook=round_hook, timeout=180
@@ -544,6 +556,7 @@ def run_scenario(
                         )
                 samples = list(sampler.samples)
                 bound_samples = list(bsampler.samples)
+                tier_samples = list(tsampler.samples)
             finally:
                 with cond:
                     progress["done"] = True
@@ -590,6 +603,8 @@ def run_scenario(
         verdicts.append(
             check_adaptive_bound(bound_samples, bound, ceiling)
         )
+    if scenario.tiered:
+        verdicts.append(check_tier_residency(tier_samples))
     if scenario.parity:
         if values is None:
             verdicts.append(Verdict(

@@ -32,14 +32,17 @@ round-trips byte-identically through :meth:`Scenario.to_json` /
 committed as.
 
 ``BUILTIN_SCENARIOS`` is the fixed-seed battery tier-1 replays —
-thirteen scenarios covering every proxy fault class, including the
+fourteen scenarios covering every proxy fault class, including the
 asymmetric partition splitting a live migration,
 kill-primary-under-partition, the partition-client-mid-lease schedule
 proving the hot-key cache's staleness bound holds through a fault
 (hotcache/, docs/hotcache.md), and the two ROADMAP-5 full-stack
 workload scenarios (``pa_full_stack``, ``sketch_full_stack``:
 train-while-serve-while-resize-while-faulted for the non-MF learners,
-workloads/ + docs/workloads.md) — plus ``VIOLATION_SCENARIO``, the
+workloads/ + docs/workloads.md), and the ISSUE-20
+``kill_promote_cold_tier`` anchor (failover over a mostly-demoted
+two-tier store, tierstore/ + docs/tierstore.md) — plus
+``VIOLATION_SCENARIO``, the
 deliberately seeded corruption the checkers must catch.
 """
 from __future__ import annotations
@@ -156,6 +159,15 @@ class Scenario:
     # judges the spread against the CEILING (widened allowances
     # legally raise the spread to ceiling + 1).
     adaptive: bool = False
+    # two-tier parameter store (tierstore/, docs/tierstore.md): the
+    # shard slices run store_backend="tiered" with a DELIBERATELY tiny
+    # hot tier, so the schedule's reads and the recovery paths (WAL
+    # replay, promotion catch-up) must cross the demoted cold set.
+    # The runner samples per-shard tier stats live and audits the
+    # tier_residency invariant: resident rows never exceed the
+    # configured hot capacity, at any sample, through every fault.
+    tiered: bool = False
+    tier_hot_rows: int = 24
     expect: str = "pass"
 
     def __post_init__(self):
@@ -424,6 +436,27 @@ BUILTIN_SCENARIOS: Tuple[Scenario, ...] = (
     # parity checker runs with no float tolerance) even though the
     # config asked for the quantized codec.  Two workers: integer adds
     # commute, so exactness must survive interleaving too.
+    # 14. ISSUE-20 anchor: kill→promote over a COLD tier — the whole
+    # chain runs store_backend="tiered" with a hot tier far smaller
+    # than the table (24 rows vs a 56-row slice), so by round 4 most
+    # mutated rows live in the mmap cold slab.  Killing the primary
+    # and promoting its follower forces the promotion catch-up (WAL
+    # tail drain) and the post-flip serving reads through demoted
+    # rows; parity against the all-RAM oracle proves the tier swap is
+    # invisible to correctness, and the sampled tier_residency
+    # invariant proves the resident set stayed within the configured
+    # hot capacity throughout.
+    Scenario(
+        "kill_promote_cold_tier",
+        (
+            NemesisOp(4, "kill_shard", shard=0),
+            NemesisOp(4, "promote_shard", shard=0),
+        ),
+        seed=114,
+        rounds=14,
+        replicated=True,
+        tiered=True,
+    ),
     Scenario(
         "sketch_full_stack",
         (

@@ -157,6 +157,15 @@ class ChainManager:
                 registry=(
                     self.registry if self.registry is not None else False
                 ),
+                # followers mirror the primary's store tier: a
+                # promotion must not change the slice's RSS story
+                store_backend=(
+                    "tiered" if drv.config.store_backend == "tiered"
+                    else "jax"
+                ),
+                tier_hot_rows=drv.config.tier_hot_rows,
+                tier_slab_dir=drv.config.tier_slab_dir,
+                tier_decay_window=drv.config.tier_decay_window,
             )
             f.epoch = primary.epoch
             srv = ShardServer(

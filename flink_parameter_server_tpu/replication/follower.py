@@ -56,6 +56,10 @@ class ReplicaShard(ParamShard):
         follower_idx: int = 0,
         registry=None,
         profiler=None,
+        store_backend: str = "jax",
+        tier_hot_rows: int = 65536,
+        tier_slab_dir: Optional[str] = None,
+        tier_decay_window: int = 0,
     ):
         if wal_dir is None:
             raise ValueError(
@@ -63,6 +67,10 @@ class ReplicaShard(ParamShard):
                 "log is both the ack's durability and what a promotion "
                 "catches up from"
             )
+        # set before super().__init__: a tiered follower registers on
+        # the tiers snapshot registry during construction, and its
+        # label (shard-N-fK) must not clobber the primary's (shard-N)
+        self.follower_idx = int(follower_idx)
         # cluster counters off (a follower shares its primary's
         # shard_id — registering the same labels would fork the series);
         # replication-plane instruments below are the follower's own
@@ -70,6 +78,10 @@ class ReplicaShard(ParamShard):
             shard_id, partitioner, value_shape,
             init_fn=init_fn, dtype=dtype, wal_dir=wal_dir,
             registry=False, profiler=profiler,
+            store_backend=store_backend,
+            tier_hot_rows=tier_hot_rows,
+            tier_slab_dir=tier_slab_dir,
+            tier_decay_window=tier_decay_window,
         )
         self.role = "follower"
         self.staleness_bound = (

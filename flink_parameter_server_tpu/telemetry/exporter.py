@@ -269,6 +269,21 @@ class TelemetryServer(LineServer):
             ) + "\n"
             ctype = "application/json"
             status = "200 OK"
+        elif path.startswith("tiers"):
+            # the two-tier store's per-shard snapshot (tierstore/
+            # metrics.py): resident/cold/pinned row counts, slab
+            # bytes, hit/miss/promote/demote/spill counters per
+            # registered tiered store — `psctl tiers` renders this.
+            # No tiered shard registered answers null (the cluster is
+            # not running store_backend="tiered")
+            from ..tierstore.metrics import tiers_snapshot
+
+            body = json.dumps(
+                {"tiers": tiers_snapshot(),
+                 "run_id": self.registry.run_id}
+            ) + "\n"
+            ctype = "application/json"
+            status = "200 OK"
         elif path.startswith("workloads"):
             # the live per-workload rate table (workloads/runtime.py):
             # cumulative update/prediction/query counters + query
@@ -286,7 +301,7 @@ class TelemetryServer(LineServer):
             body = (
                 f"unknown path {path!r} "
                 f"(metrics|healthz|hotkeys|hot|budget|conns|"
-                f"timeline|adaptive|workloads)\n"
+                f"timeline|adaptive|tiers|workloads)\n"
             )
             ctype = "text/plain; charset=utf-8"
             status = "404 Not Found"

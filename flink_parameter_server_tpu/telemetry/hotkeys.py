@@ -32,6 +32,7 @@ sketch + SLO) under the 3% bar.
 """
 from __future__ import annotations
 
+import heapq
 import math
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -215,23 +216,38 @@ class SpaceSavingTopK:
                 errs[key] = 0
             self._key_cache = None
             return
-        # at capacity: newcomers enter at floor + n (err = floor),
-        # then the combined set is trimmed back to the top `capacity`
-        floor = min(cs.values()) if cs else 0
+        # strongest newcomers first: free slots go to the largest
+        # batch counts, and the displacement floors below ratchet in
+        # the same order per-item insertion would visit them
+        order = np.argsort(-absent_c, kind="stable")
+        absent_k, absent_c = absent_k[order], absent_c[order]
+        if free > 0:
+            for key, n in zip(
+                absent_k[:free].tolist(), absent_c[:free].tolist()
+            ):
+                cs[key] = n
+                errs[key] = 0
+            absent_k, absent_c = absent_k[free:], absent_c[free:]
+        # at capacity: sequential space-saving over a min-heap of the
+        # live counts — each admitted newcomer displaces the CURRENT
+        # minimum, entering at (displaced count + n) with err capped
+        # at the displaced key's count, i.e. the bound on how often
+        # the newcomer could have occurred unseen in that slot.  The
+        # previous batch path gave every newcomer the same pre-batch
+        # floor and trimmed the union by raw count, which could evict
+        # incumbents counted above the rolling minimum (the
+        # over-admission documented in PR 11); with the ratcheting
+        # heap floor a batch admits exactly what per-item insertion
+        # admits, and errors ratchet with it.
+        heap = [(c, k) for k, c in cs.items()]
+        heapq.heapify(heap)
         for key, n in zip(absent_k.tolist(), absent_c.tolist()):
+            floor, victim = heap[0]
+            heapq.heapreplace(heap, (floor + n, key))
+            del cs[victim]
+            errs.pop(victim, None)
             cs[key] = floor + n
             errs[key] = floor
-        if len(cs) > self.capacity:
-            keys = np.fromiter(cs.keys(), np.int64, len(cs))
-            vals = np.fromiter(cs.values(), np.int64, len(cs))
-            keep_idx = np.argpartition(-vals, self.capacity - 1)[
-                : self.capacity
-            ]
-            keep = set(keys[keep_idx].tolist())
-            self._counts = {k: v for k, v in cs.items() if k in keep}
-            self._errs = {
-                k: e for k, e in errs.items() if k in keep
-            }
         self._key_cache = None
 
     def halve(self) -> None:

@@ -7,7 +7,9 @@ round-stamped ``BENCH_r0*.json`` captures at the repo root (``{"n":
 benchmark suites' ``results/<platform>/*.json`` artifacts
 (``{"captured_at": ..., "payload": {"metric", "value", "unit", ...}}``
 — cluster_scaling, elastic_scaling, recovery_time, serving_qps,
-failover_time, nemesis, ...).
+failover_time, nemesis, tierstore_soak, ...; tierstore_soak's
+pull-latency ratio is a ``x slowdown`` unit so the worse direction is
+upward).
 Until this tool, comparing a metric across rounds meant opening each
 file by hand — so regressions slid by unless someone remembered the
 old number.  This folds them all into one metric × round table and

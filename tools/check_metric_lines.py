@@ -25,9 +25,9 @@ Registry samples (``"kind": "registry"``) additionally have every
 typo'd component silently forks a dashboard's series, so it fails the
 lint instead.
 
-Seven further artifact shapes from the observability plane lint here
+Eight further artifact shapes from the observability plane lint here
 too (docs/observability.md, docs/loadgen.md, docs/meshstore.md,
-docs/adaptive.md):
+docs/adaptive.md, docs/tierstore.md):
 
     python tools/check_metric_lines.py --trace merged_trace.json
     python tools/check_metric_lines.py --flightrec flightrec_stall.json
@@ -36,6 +36,7 @@ docs/adaptive.md):
     python tools/check_metric_lines.py --mesh-ab mesh_backend_ab.json
     python tools/check_metric_lines.py --timeline soak_timeline.json
     python tools/check_metric_lines.py --straggler-ab straggler_ab.json
+    python tools/check_metric_lines.py --tier tierstore_soak.json
 
 ``--trace`` checks a Chrome trace-event JSON array (the
 ``TraceCollector`` merge format): every ``X`` event carries ``pid``,
@@ -75,8 +76,15 @@ chaos, same deadline) with numeric goodput and final-table RMSE, the
 goodput ratio is recorded at workload level, the adaptive arm counts
 every mechanism's firings (a "win" with zero widenings/hedges/moves
 means the control loop never ran), and the bound-envelope invariant
-is green (effective bounds stayed inside [bound, ceiling]).  A mode
-flag applies to the paths that follow it.
+is green (effective bounds stayed inside [bound, ceiling]).
+``--tier`` checks a two-tier store soak artifact
+(benchmarks/tierstore_soak.py, docs/tierstore.md): ts/run_id stamped,
+the RSS bound is RECORDED and the tiered arm's peak RSS stayed under
+it, the pull-overhead ratio travels with its limit and honours it,
+``hit_rate`` is a number in [0, 1], the hit/miss ledger balances
+against references, and every correctness leg (bitwise parity,
+kill→promote, WAL replay, migration) is green.  A mode flag applies
+to the paths that follow it.
 """
 from __future__ import annotations
 
@@ -92,7 +100,8 @@ KNOWN_COMPONENTS = frozenset(
     {"train", "serving", "ingest", "recovery", "cluster",
      "serving_dispatch", "elastic", "slo", "profiler", "net",
      "replication", "nemesis", "hotcache", "loadgen", "compression",
-     "workloads", "shmem", "meshstore", "timeline", "adaptive"}
+     "workloads", "shmem", "meshstore", "timeline", "adaptive",
+     "tierstore"}
 )
 
 
@@ -635,6 +644,91 @@ def check_straggler_ab(doc: Any) -> List[str]:
     return bad
 
 
+# the legs a tierstore artifact must prove green — the RSS number is
+# only meaningful if the bounded store also stayed CORRECT across
+# every recovery plane on the same commit
+_TIER_LEGS = (
+    "parity_bitwise", "kill_promote", "wal_replay", "migration",
+)
+
+
+def check_tier(doc: Any) -> List[str]:
+    """Lint a two-tier store soak artifact (benchmarks/tierstore_soak.py
+    format, docs/tierstore.md): the RSS bound is RECORDED and honoured
+    (peak ≤ bound — a soak that never wrote down its own bound proves
+    nothing), the pull-overhead bar travels with its limit, the
+    hit/miss ledger balances, and every correctness leg is green."""
+    bad: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"tier document is {type(doc).__name__}, expected a "
+                f"JSON object"]
+    if not isinstance(doc.get("ts"), (int, float)):
+        bad.append("missing/non-numeric 'ts'")
+    if not isinstance(doc.get("run_id"), str):
+        bad.append("missing/non-string 'run_id'")
+    tier = doc.get("tier")
+    if not isinstance(tier, dict):
+        bad.append("missing/non-object 'tier'")
+        return bad
+    bound = tier.get("rss_bound_bytes")
+    peak = tier.get("tiered_peak_rss_bytes")
+    if not isinstance(bound, (int, float)) or bound <= 0:
+        bad.append("missing/non-positive 'tier.rss_bound_bytes' — the "
+                   "bounded-RSS claim must record its own bound")
+    if not isinstance(peak, (int, float)) or peak <= 0:
+        bad.append("missing/non-positive 'tier.tiered_peak_rss_bytes'")
+    if (isinstance(bound, (int, float)) and isinstance(peak, (int, float))
+            and peak > bound):
+        bad.append(
+            f"tiered peak RSS {int(peak)} exceeds the recorded bound "
+            f"{int(bound)} — the bounded-residency claim is violated"
+        )
+    ratio = tier.get("pull_p50_ratio")
+    limit = tier.get("pull_overhead_limit")
+    if not isinstance(ratio, (int, float)):
+        bad.append("missing/non-numeric 'tier.pull_p50_ratio'")
+    if not isinstance(limit, (int, float)) or limit <= 0:
+        bad.append("missing/non-positive 'tier.pull_overhead_limit' — "
+                   "the overhead bar travels with the number")
+    elif isinstance(ratio, (int, float)) and ratio > limit:
+        bad.append(
+            f"pull p50 overhead {ratio} exceeds the recorded limit "
+            f"{limit}"
+        )
+    hit_rate = tier.get("hit_rate")
+    if not isinstance(hit_rate, (int, float)) or not 0.0 <= hit_rate <= 1.0:
+        bad.append(f"'tier.hit_rate' must be a number in [0, 1] "
+                   f"(got {hit_rate!r})")
+    ledger = tier.get("ledger")
+    if not isinstance(ledger, dict):
+        bad.append("missing/non-object 'tier.ledger'")
+    else:
+        h, m, refs = (ledger.get(k) for k in
+                      ("hits", "misses", "references"))
+        if not all(isinstance(v, int) for v in (h, m, refs)):
+            bad.append("'tier.ledger' fields (hits/misses/references) "
+                       "must be integers")
+        elif h + m != refs:
+            bad.append(
+                f"tier ledger does not balance — references={refs} "
+                f"but hits+misses={h + m}"
+            )
+    legs = tier.get("legs")
+    if not isinstance(legs, dict) or not legs:
+        bad.append("missing/empty 'tier.legs' — the correctness legs "
+                   "must travel with the perf number")
+    else:
+        for leg in _TIER_LEGS:
+            if legs.get(leg) is not True:
+                bad.append(
+                    f"tier leg {leg!r} is not green (got "
+                    f"{legs.get(leg)!r}) — the RSS/latency numbers "
+                    f"only count on a commit whose recovery planes "
+                    f"pass"
+                )
+    return bad
+
+
 def _check_json_artifact(path: str, checker) -> List[str]:
     try:
         with open(path) as f:
@@ -665,6 +759,8 @@ def main(argv: List[str]) -> int:
             mode = "timeline"
         elif a == "--straggler-ab":
             mode = "straggler_ab"
+        elif a == "--tier":
+            mode = "tier"
         elif a == "--lines":
             mode = "lines"
         elif a in ("-h", "--help"):
@@ -675,13 +771,13 @@ def main(argv: List[str]) -> int:
     if not jobs:
         print("usage: check_metric_lines.py [--allow-missing-ids] "
               "[--trace|--flightrec|--budget|--soak|--mesh-ab|"
-              "--timeline|--straggler-ab|--lines] <file|-> ...",
+              "--timeline|--straggler-ab|--tier|--lines] <file|-> ...",
               file=sys.stderr)
         return 2
     failed = False
     for mode, path in jobs:
         if mode in ("trace", "flightrec", "budget", "soak", "mesh_ab",
-                    "timeline", "straggler_ab"):
+                    "timeline", "straggler_ab", "tier"):
             checker = {
                 "trace": check_trace_events,
                 "flightrec": check_flightrec,
@@ -690,6 +786,7 @@ def main(argv: List[str]) -> int:
                 "mesh_ab": check_mesh_ab,
                 "timeline": check_timeline,
                 "straggler_ab": check_straggler_ab,
+                "tier": check_tier,
             }[mode]
             problems = _check_json_artifact(path, checker)
             for reason in problems:

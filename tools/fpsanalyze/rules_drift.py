@@ -114,7 +114,7 @@ def default_drift_config(root: str) -> DriftConfig:
             "docs/elastic.md", "docs/loadgen.md",
             "docs/compression.md", "docs/workloads.md",
             "docs/shmem.md", "docs/meshstore.md",
-            "docs/adaptive.md",
+            "docs/adaptive.md", "docs/tierstore.md",
         ],
         known_components=KNOWN_COMPONENTS,
         metric_scan_prefixes=[pkg + "/"],

@@ -23,6 +23,8 @@ Usage::
                  [--json]
     psctl workloads --metrics HOST:PORT [--interval 2]
                  [--iterations 0] [--json]
+    psctl tiers  --metrics HOST:PORT [--interval 2] [--iterations 0]
+                 [--json]
     psctl watch  --metrics HOST:PORT [--interval 2] [--iterations 0]
                  [-n 16] [--raw]
     psctl timeline METRIC --metrics HOST:PORT [--json]
@@ -80,6 +82,17 @@ path's cumulative counters between scrapes, plus the serving-verb
 latency percentiles (``fps_workload_query_latency_seconds``) and
 serving errors.  The first frame shows cumulative totals (in
 parentheses) until a second scrape makes rates derivable.
+
+``tiers`` is the two-tier store operator view (docs/tierstore.md): one
+row per registered tiered store (primaries ``shard-N``, chain
+followers ``shard-N-fK``) from the telemetry endpoint's ``tiers``
+path — resident vs configured hot capacity, pinned rows, cold-slab
+rows and bytes, the cumulative hit rate, and promote/demote/spill
+counters.  With ``--interval`` the hit-rate column becomes a LIVE
+rate (hits/misses diffed between scrapes); the first frame shows the
+cumulative rate in parentheses.  A process with no tiered shard
+answers null and the verb says so (the cluster is not running
+``store_backend="tiered"``).
 
 ``watch`` is the trend view over ``top``'s numbers: every counter the
 endpoint exports (identified from the ``# TYPE`` comment lines) gets a
@@ -523,6 +536,90 @@ def cmd_workloads(args) -> int:
             sys.stdout.write("\x1b[2J\x1b[H")
         print(screen, flush=True)
         prev, prev_t = table, now
+        shown += 1
+        if args.iterations and shown >= args.iterations:
+            return 0
+        time.sleep(args.interval)
+
+
+def cmd_tiers(args) -> int:
+    """Live per-store tier table (docs/tierstore.md): resident vs hot
+    capacity, pinned rows, slab size, hit rate and the tier-movement
+    counters, diffed between scrapes of the TelemetryServer ``tiers``
+    path (tierstore/metrics.tiers_snapshot)."""
+    host, port = parse_addr(args.metrics)
+    prev: Dict[str, dict] = {}
+    prev_t: Optional[float] = None
+    shown = 0
+    while True:
+        try:
+            doc = json.loads(scrape(host, port, "tiers"))
+        except (OSError, ValueError) as e:
+            print(f"psctl: {host}:{port} unreachable: {e}",
+                  file=sys.stderr)
+            return 1
+        tiers = doc.get("tiers")
+        if args.json:
+            print(json.dumps(
+                {"tiers": tiers, "run_id": doc.get("run_id")},
+                indent=2, sort_keys=True,
+            ))
+            return 0
+        if tiers is None:
+            print("psctl: no tiered shard registered on this process "
+                  "(the cluster is not running store_backend=\"tiered\")",
+                  file=sys.stderr)
+            return 1
+        now = time.monotonic()
+        dt = (now - prev_t) if prev_t is not None else None
+        rows = []
+        for label in sorted(tiers):
+            st = tiers[label]
+            hits = int(st.get("hits", 0))
+            misses = int(st.get("misses", 0))
+
+            def hit_rate(h: int, m: int) -> str:
+                return f"{h / (h + m):.3f}" if (h + m) > 0 else "—"
+
+            if dt and label in prev:
+                dh = hits - int(prev[label].get("hits", 0))
+                dm = misses - int(prev[label].get("misses", 0))
+                rate = hit_rate(dh, dm)
+            else:
+                rate = f"({hit_rate(hits, misses)})"  # cumulative
+            rows.append([
+                label, str(st.get("role", "?")),
+                f"{st.get('resident_rows', 0)}/"
+                f"{st.get('hot_capacity_rows', 0)}",
+                str(st.get("pinned_rows", 0)),
+                str(st.get("slab_rows", 0)),
+                _fmt_bytes(st.get("slab_bytes", 0)),
+                rate,
+                str(st.get("promotes", 0)),
+                str(st.get("demotes", 0)),
+                str(st.get("spills", 0)),
+            ])
+        lines = [
+            f"psctl tiers — {host}:{port} — "
+            f"{time.strftime('%H:%M:%S', time.localtime())} — "
+            f"hit rate is per-interval "
+            f"(first frame: cumulative in parentheses)",
+        ]
+        if rows:
+            lines.append("")
+            lines.append(_render_table(
+                ["store", "role", "resident/cap", "pinned",
+                 "slab rows", "slab bytes", "hit rate", "promotes",
+                 "demotes", "spills"],
+                rows,
+            ))
+        else:
+            lines.append("(tiered stores registered, none reporting)")
+        screen = "\n".join(lines)
+        if not args.raw:
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(screen, flush=True)
+        prev, prev_t = tiers, now
         shown += 1
         if args.iterations and shown >= args.iterations:
             return 0
@@ -1091,6 +1188,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     wl.add_argument("--json", action="store_true",
                     help="emit the raw payload once")
     wl.set_defaults(fn=cmd_workloads)
+
+    ti = sub.add_parser(
+        "tiers",
+        help="two-tier store table: residency, slab size, hit rate, "
+             "tier movement",
+    )
+    ti.add_argument("--metrics", required=True, metavar="HOST:PORT")
+    ti.add_argument("--interval", type=float, default=2.0)
+    ti.add_argument("--iterations", type=int, default=0,
+                    help="stop after N frames (0 = forever)")
+    ti.add_argument("--raw", action="store_true",
+                    help="no screen clear (pipe/CI friendly)")
+    ti.add_argument("--json", action="store_true",
+                    help="emit the raw payload once")
+    ti.set_defaults(fn=cmd_tiers)
 
     wa = sub.add_parser(
         "watch",
